@@ -1,0 +1,10 @@
+"""dalle_pytorch_tpu — a TPU-native text-to-image framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capability surface of
+DALLE-pytorch (studied at /root/reference): discrete VAEs, the DALL-E
+autoregressive text+image transformer with full/axial/conv/block-sparse
+attention, CLIP reranking, tokenizers, data pipelines, and a device-mesh
+parallelism runtime replacing the reference's DeepSpeed/Horovod backends.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from .transformer import Transformer
+
+__all__ = ["Transformer"]

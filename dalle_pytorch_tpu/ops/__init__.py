@@ -1,0 +1,37 @@
+from . import masks, rotary
+from .attention import PatternAttention, dense_attend
+from .layers import (
+    FeedForward,
+    GMLPBlock,
+    LayerScale,
+    PreNorm,
+    PreShiftToken,
+    SpatialGatingUnit,
+    divide_max,
+    layer_scale_init,
+    shift_tokens,
+    stable_softmax,
+)
+from .reversible import reversible_forward_only, reversible_sequence
+from .rotary import apply_rotary_emb, dalle_rotary_table
+
+__all__ = [
+    "masks",
+    "rotary",
+    "PatternAttention",
+    "dense_attend",
+    "FeedForward",
+    "GMLPBlock",
+    "LayerScale",
+    "PreNorm",
+    "PreShiftToken",
+    "SpatialGatingUnit",
+    "divide_max",
+    "layer_scale_init",
+    "shift_tokens",
+    "stable_softmax",
+    "reversible_forward_only",
+    "reversible_sequence",
+    "apply_rotary_emb",
+    "dalle_rotary_table",
+]

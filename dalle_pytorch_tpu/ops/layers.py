@@ -1,0 +1,282 @@
+"""Core layer primitives (flax.linen), TPU-native.
+
+Covers the reference's transformer building blocks (transformer.py:30-126):
+DivideMax, LayerScale, PreNorm, GEGLU feed-forward, and the CogView-style
+token-shift wrapper. All modules take explicit compute/param dtypes so the
+whole stack can run bf16 on the MXU with f32 parameters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+Dtype = Any
+
+
+def stable_softmax(t: jnp.ndarray, axis: int = -1, alpha: float = 32.0**2) -> jnp.ndarray:
+    """Numerically-tamed softmax used when ``stable=True``
+    (reference attention.py:27-30): divide by alpha before the max-subtraction
+    so large logits don't overflow in low precision."""
+    t = t / alpha
+    t = t - jnp.max(t, axis=axis, keepdims=True)
+    return nn.softmax(t * alpha, axis=axis)
+
+
+def divide_max(x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Divide by the per-slice max (reference transformer.py:30-37)."""
+    return x / jnp.max(x, axis=axis, keepdims=True)
+
+
+def layer_scale_init(depth: int) -> float:
+    """Depth-dependent LayerScale init (reference transformer.py:40-48):
+    0.1 up to depth 18, 1e-5 to 24, 1e-6 beyond."""
+    if depth <= 18:
+        return 0.1
+    if depth <= 24:
+        return 1e-5
+    return 1e-6
+
+
+class LayerScale(nn.Module):
+    """Scale a wrapped function's output by a learned per-channel gain
+    initialised small (CaiT, arXiv:2103.17239; reference transformer.py:40-54)."""
+
+    dim: int
+    depth: int
+    fn: nn.Module
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, **kwargs):
+        init = layer_scale_init(self.depth)
+        scale = self.param(
+            "scale",
+            lambda key, shape: jnp.full(shape, init, dtype=self.param_dtype),
+            (self.dim,),
+        )
+        return self.fn(x, **kwargs) * scale.astype(x.dtype)
+
+
+class PreNorm(nn.Module):
+    """LayerNorm then fn (reference transformer.py:58-65). The norm runs in
+    f32 for stability regardless of compute dtype."""
+
+    dim: int
+    fn: nn.Module
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, **kwargs):
+        y = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype)(x)
+        return self.fn(y.astype(x.dtype), **kwargs)
+
+
+class FeedForward(nn.Module):
+    """GEGLU feed-forward (reference transformer.py:69-85): one fused
+    projection to 2 * mult * dim, gated gelu, projection back. The doubled
+    projection keeps the MXU fed with one large matmul instead of two."""
+
+    dim: int
+    mult: float = 4.0
+    dropout: float = 0.0
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        hidden = int(self.dim * self.mult)
+        x = nn.Dense(hidden * 2, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x, gates = jnp.split(x, 2, axis=-1)
+        x = x * nn.gelu(gates)
+        x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        x = nn.Dense(self.dim, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        return x
+
+
+def shift_tokens(x: jnp.ndarray, text_len: int, image_size: int) -> jnp.ndarray:
+    """CogView/RWKV token shift over a mixed text+image sequence
+    (reference transformer.py:96-126).
+
+    Text tokens (first ``text_len`` positions, <bos> included): the first half
+    of channels is replaced by the previous token's. Image tokens (reshaped to
+    an image_size x image_size grid, zero-padded to a full grid): the first
+    quarter of channels comes from the token one row up, the second quarter
+    from the token one column left.
+
+    Static-shape: works on the full sequence; callers pass the model's fixed
+    sequence length.
+    """
+    b, n, d = x.shape
+    img_seq_len = image_size**2
+    padding = text_len + img_seq_len - n
+
+    x_text, x_img = x[:, :text_len], x[:, text_len:]
+    x_img = jnp.pad(x_img, ((0, 0), (0, padding), (0, 0)))
+    x_img = x_img.reshape(b, image_size, image_size, d)
+
+    # text: shift half the channels right by one token
+    x_text_shift, x_text_pass = jnp.split(x_text, 2, axis=-1)
+    x_text_shift = jnp.pad(x_text_shift, ((0, 0), (1, 0), (0, 0)))[:, :-1]
+    x_text = jnp.concatenate((x_text_shift, x_text_pass), axis=-1)
+
+    # image: quarter from the row above, quarter from the column left
+    q = d // 4
+    top, left, passthrough = x_img[..., :q], x_img[..., q : 2 * q], x_img[..., 2 * q :]
+    top = jnp.pad(top, ((0, 0), (1, 0), (0, 0), (0, 0)))[:, :-1]
+    left = jnp.pad(left, ((0, 0), (0, 0), (1, 0), (0, 0)))[:, :, :-1]
+    x_img = jnp.concatenate((top, left, passthrough), axis=-1)
+
+    x_img = x_img.reshape(b, img_seq_len, d)
+    if padding:
+        x_img = x_img[:, :-padding]
+    return jnp.concatenate((x_text, x_img), axis=1)
+
+
+class PreShiftToken(nn.Module):
+    """Apply token shift, then the wrapped function
+    (reference transformer.py:89-126).
+
+    In decode mode a history cache of raw inputs supplies the previous-token
+    and row-above features the shift needs, so KV-cached sampling stays O(1)
+    per step. ``pass_decode`` controls whether the wrapped fn also receives
+    the decode flag (attention does, feed-forward doesn't).
+    """
+
+    fn: nn.Module
+    image_size: int
+    seq_len: int
+    pass_decode: bool = False
+
+    @nn.compact
+    def __call__(self, x, decode: bool = False, **kwargs):
+        img_seq_len = self.image_size**2
+        text_len = self.seq_len - img_seq_len + 1
+        inner_kwargs = dict(kwargs)
+        if self.pass_decode:
+            inner_kwargs["decode"] = decode
+
+        if not decode:
+            x = shift_tokens(x, text_len, self.image_size)
+            return self.fn(x, **inner_kwargs)
+
+        b, n, d = x.shape
+        total = text_len + img_seq_len
+        is_init = not self.has_variable("cache", "shift_hist")
+        hist = self.variable("cache", "shift_hist", jnp.zeros, (b, total, d), x.dtype)
+        pos_var = self.variable("cache", "shift_index", lambda: jnp.array(0, jnp.int32))
+        if is_init:
+            return self.fn(x, **inner_kwargs)
+
+        pos = pos_var.value
+        hist.value = jax.lax.dynamic_update_slice(hist.value, x, (0, pos, 0))
+        prev = jax.lax.dynamic_slice(
+            hist.value, (0, jnp.maximum(pos - 1, 0), 0), (b, 1, d)
+        )
+        row_above = jax.lax.dynamic_slice(
+            hist.value, (0, jnp.maximum(pos - self.image_size, 0), 0), (b, 1, d)
+        )
+        pos_var.value = pos + 1
+        x = shift_tokens_decode(x, pos, prev, row_above, text_len, self.image_size)
+        return self.fn(x, **inner_kwargs)
+
+
+class SpatialGatingUnit(nn.Module):
+    """gMLP spatial gating (arXiv:2105.08050; the reference pulls this in from
+    the external g-mlp-pytorch package for attn_type='mlp',
+    transformer.py:13,170-178): half the channels gate the other half through
+    a learned, optionally causal, seq x seq spatial mixing matrix."""
+
+    seq_len: int
+    causal: bool = True
+    init_eps: float = 1e-3
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        n = x.shape[-2]
+        res, gate = jnp.split(x, 2, axis=-1)
+        gate = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype)(gate)
+        gate = gate.astype(x.dtype)
+
+        eps = self.init_eps / self.seq_len
+        weight = self.param(
+            "spatial_weight",
+            nn.initializers.uniform(scale=2 * eps),
+            (self.seq_len, self.seq_len),
+            self.param_dtype,
+        ) - eps
+        bias = self.param(
+            "spatial_bias", nn.initializers.ones, (self.seq_len,), self.param_dtype
+        )
+        w = weight[:n, :n]
+        if self.causal:
+            w = jnp.where(jnp.tril(jnp.ones((n, n), dtype=bool)), w, 0.0)
+        gate = jnp.einsum("bnd,mn->bmd", gate, w.astype(x.dtype))
+        gate = gate + bias[:n, None].astype(x.dtype)
+        return res * gate
+
+
+class GMLPBlock(nn.Module):
+    """Causal gMLP block used for attn_type='mlp' layers."""
+
+    dim: int
+    dim_ff: int
+    seq_len: int
+    causal: bool = True
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        x = nn.Dense(self.dim_ff, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        x = nn.gelu(x)
+        x = SpatialGatingUnit(
+            seq_len=self.seq_len,
+            causal=self.causal,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )(x)
+        x = nn.Dense(self.dim, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        return x
+
+
+def shift_tokens_decode(
+    x: jnp.ndarray,
+    pos: jnp.ndarray,
+    prev_token: jnp.ndarray,
+    row_above_token: jnp.ndarray,
+    text_len: int,
+    image_size: int,
+) -> jnp.ndarray:
+    """Single-position token shift for the KV-cached decode loop.
+
+    x: (b, 1, d) current token features; pos: scalar int32 global position;
+    prev_token / row_above_token: (b, 1, d) features of positions pos-1 and
+    pos-image_size (zeros when out of range / across a boundary).
+    """
+    d = x.shape[-1]
+    is_text = pos < text_len
+    p_img = pos - text_len
+    col = p_img % image_size
+    row = p_img // image_size
+
+    half, quarter = d // 2, d // 4
+
+    # text branch: first half channels from previous token (zero at pos 0)
+    prev_ok_text = (pos > 0) & is_text
+    text_shift = jnp.where(prev_ok_text, prev_token[..., :half], 0.0)
+    text_out = jnp.concatenate((text_shift, x[..., half:]), axis=-1)
+
+    # image branch
+    top_ok = row > 0
+    left_ok = col > 0
+    top = jnp.where(top_ok, row_above_token[..., :quarter], 0.0)
+    left = jnp.where(left_ok, prev_token[..., quarter : 2 * quarter], 0.0)
+    img_out = jnp.concatenate((top, left, x[..., 2 * quarter :]), axis=-1)
+
+    return jnp.where(is_text, text_out, img_out)

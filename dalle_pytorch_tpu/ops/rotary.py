@@ -1,0 +1,103 @@
+"""Rotary position embeddings, TPU-native.
+
+Re-implements (from scratch, in JAX) the rotary scheme the reference composes out
+of the external ``rotary-embedding-torch`` package: 1-D language frequencies,
+2-D axial "pixel" frequencies, and the DALL-E-specific 3-part head-dim split in
+which text positions carry 1-D rotary angles and image positions carry 2-D
+axial angles, with each modality pinned to a far-away constant position in the
+other modality's coordinate system (reference: transformer.py:196-224,
+attention.py:32-35).
+
+Everything here is a pure function over static shapes: the full angle table for
+a (text + image) sequence is precomputed once at model-build time and indexed
+inside the compiled step, so nothing in the hot path is data-dependent.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def lang_freqs(dim: int, theta: float = 10000.0) -> np.ndarray:
+    """1-D rotary frequency ladder for token positions (dim//2 frequencies)."""
+    return 1.0 / (theta ** (np.arange(0, dim, 2)[: dim // 2] / dim))
+
+
+def pixel_freqs(dim: int, max_freq: float = 10.0) -> np.ndarray:
+    """Frequencies for continuous pixel coordinates in [-1, 1]."""
+    return np.linspace(1.0, max_freq / 2, dim // 2) * np.pi
+
+
+def angles(positions: np.ndarray, freqs: np.ndarray) -> np.ndarray:
+    """Outer product position x freq, each frequency repeated twice
+    (interleaved) so the angle table lines up with adjacent rotation pairs.
+
+    Returns shape (*positions.shape, 2 * len(freqs)).
+    """
+    a = np.einsum("...i,j->...ij", np.asarray(positions, dtype=np.float64), freqs)
+    return np.repeat(a, 2, axis=-1).reshape(*positions.shape, -1)
+
+
+def rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    """Per adjacent pair (x1, x2) -> (-x2, x1)."""
+    x = x.reshape(*x.shape[:-1], -1, 2)
+    x1, x2 = x[..., 0], x[..., 1]
+    return jnp.stack((-x2, x1), axis=-1).reshape(*x.shape[:-2], -1)
+
+
+def apply_rotary_emb(angle_table: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
+    """Rotate the leading ``angle_table.shape[-1]`` channels of ``t``.
+
+    angle_table: (..., n, rot_dim) broadcastable to t's (..., n, d) prefix.
+    Channels past rot_dim pass through untouched (the reference rotates only
+    3 * (dim_head // 3 // 2 * 2) of every head's channels).
+    """
+    rot_dim = angle_table.shape[-1]
+    t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
+    angle_table = angle_table.astype(t.dtype)
+    t_rot = t_rot * jnp.cos(angle_table) + rotate_half(t_rot) * jnp.sin(angle_table)
+    return jnp.concatenate((t_rot, t_pass), axis=-1)
+
+
+def dalle_rotary_table(
+    dim_head: int,
+    text_len: int,
+    image_fmap_size: int,
+    theta: float = 10000.0,
+    max_freq: float = 10.0,
+) -> np.ndarray:
+    """Precompute the DALL-E rotary angle table.
+
+    ``text_len`` counts the <bos> token (reference text_seq_len + 1); the image
+    part has image_fmap_size**2 positions. Output shape is
+    (text_len + image_fmap_size**2 - 1, 3 * 2 * (dim_head // 3 // 2)) — the
+    trailing position is dropped because the model truncates the final token
+    before the transformer (reference transformer.py:221-222).
+
+    Layout along the channel axis, mirroring the reference scheme:
+      [0, r)    : 1-D text angles; image positions pinned at position 8192
+      [r, 3r)   : 2-D axial pixel angles (row then col); text pinned at -10
+    where r = 2 * (dim_head // 3 // 2).
+    """
+    rot_dim = dim_head // 3
+    img_seq_len = image_fmap_size**2
+
+    lf = lang_freqs(rot_dim, theta)
+    pf = pixel_freqs(rot_dim, max_freq)
+
+    # 1-D text part.
+    text_1d = angles(np.arange(text_len), lf)
+    img_1d = angles(np.full((img_seq_len,), 8192.0), lf)
+    part_text = np.concatenate((text_1d, img_1d), axis=0)
+
+    # 2-D axial image part over a [-1, 1] pixel grid.
+    axial = angles(np.linspace(-1.0, 1.0, image_fmap_size), pf)  # (f, r)
+    rows = np.broadcast_to(axial[:, None, :], (image_fmap_size, image_fmap_size, axial.shape[-1]))
+    cols = np.broadcast_to(axial[None, :, :], (image_fmap_size, image_fmap_size, axial.shape[-1]))
+    img_2d = np.concatenate((rows, cols), axis=-1).reshape(img_seq_len, -1)
+    text_2d = np.tile(angles(np.full((text_len,), -10.0), pf), (1, 2))
+    part_axial = np.concatenate((text_2d, img_2d), axis=0)
+
+    table = np.concatenate((part_text, part_axial), axis=-1)
+    return table[:-1].astype(np.float32)

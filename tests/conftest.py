@@ -1,0 +1,27 @@
+"""Test harness: force an 8-device virtual CPU platform so mesh/sharding
+tests run anywhere — the TPU-native analog of the reference's DummyBackend
+(dummy_backend.py), per SURVEY.md §4.
+
+Note: the platform override must go through jax.config (not just the
+JAX_PLATFORMS env var) because site hooks may have already pinned a
+platform list; the explicit config update wins as long as no backend has
+been initialized yet.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+assert jax.local_device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)

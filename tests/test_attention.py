@@ -1,0 +1,131 @@
+"""Attention family tests: efficient paths vs the dense-masked oracle,
+pattern-correct information flow, and KV-cached decode parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.ops.attention import PatternAttention
+from dalle_pytorch_tpu.ops.rotary import dalle_rotary_table
+
+F = 4  # image grid
+TEXT_LEN = 5  # includes <bos>
+L = TEXT_LEN + F * F  # internal pattern length
+N = L - 1  # model sequence (last token truncated)
+DIM, HEADS, DIM_HEAD = 32, 2, 16
+
+
+def make_attn(attn_type, **kw):
+    return PatternAttention(
+        dim=DIM,
+        seq_len=L,
+        attn_type=attn_type,
+        heads=HEADS,
+        dim_head=DIM_HEAD,
+        image_fmap_size=F,
+        block_size=4,
+        num_random_blocks=1,
+        **kw,
+    )
+
+
+def rotary_table():
+    return jnp.asarray(dalle_rotary_table(DIM_HEAD, TEXT_LEN, F))
+
+
+@pytest.fixture(scope="module")
+def x():
+    return jax.random.normal(jax.random.PRNGKey(0), (2, N, DIM))
+
+
+@pytest.mark.parametrize("attn_type", ["axial_row", "axial_col", "conv_like"])
+@pytest.mark.parametrize("use_rotary", [False, True])
+def test_efficient_path_matches_dense_oracle(x, attn_type, use_rotary):
+    attn = make_attn(attn_type)
+    params = attn.init(jax.random.PRNGKey(1), x)
+    rot = rotary_table() if use_rotary else None
+    eff = attn.apply(params, x, rotary_pos_emb=rot)
+    dense = attn.apply(params, x, rotary_pos_emb=rot, force_dense=True)
+    np.testing.assert_allclose(np.asarray(eff), np.asarray(dense), atol=2e-5)
+
+
+@pytest.mark.parametrize("attn_type", ["axial_row", "conv_like"])
+def test_efficient_path_with_key_mask(x, attn_type):
+    attn = make_attn(attn_type)
+    params = attn.init(jax.random.PRNGKey(1), x)
+    mask = jnp.asarray(np.random.RandomState(0).rand(2, L) > 0.3)
+    mask = mask.at[:, 0].set(True)  # <bos> always visible
+    eff = attn.apply(params, x, mask=mask)
+    dense = attn.apply(params, x, mask=mask, force_dense=True)
+    np.testing.assert_allclose(np.asarray(eff), np.asarray(dense), atol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "attn_type", ["full", "axial_row", "axial_col", "conv_like", "sparse"]
+)
+def test_information_flow_matches_pattern(attn_type):
+    """Perturbing input j changes output i only if the pattern allows i<-j."""
+    attn = make_attn(attn_type)
+    x0 = jax.random.normal(jax.random.PRNGKey(2), (1, N, DIM))
+    params = attn.init(jax.random.PRNGKey(1), x0)
+    base = np.asarray(attn.apply(params, x0))
+    allowed = attn.pattern_mask()
+
+    for j in [0, TEXT_LEN - 1, TEXT_LEN + 1, TEXT_LEN + F + 2]:
+        x1 = x0.at[0, j].add(1.0)
+        out = np.asarray(attn.apply(params, x1))
+        changed = np.abs(out - base).max(axis=-1)[0] > 1e-6
+        for i in range(N):
+            if i == j:
+                continue
+            assert changed[i] == bool(allowed[i, j]), (
+                f"{attn_type}: output {i} vs perturbed {j}: "
+                f"changed={changed[i]} allowed={allowed[i, j]}"
+            )
+
+
+@pytest.mark.parametrize("attn_type", ["full", "axial_row", "conv_like", "sparse"])
+@pytest.mark.parametrize("use_rotary", [False, True])
+def test_decode_matches_full_forward(attn_type, use_rotary):
+    attn = make_attn(attn_type)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, N, DIM))
+    params = attn.init(jax.random.PRNGKey(1), x)
+    rot = rotary_table() if use_rotary else None
+    full = np.asarray(attn.apply(params, x, rotary_pos_emb=rot))
+
+    cache = attn.init(jax.random.PRNGKey(1), x[:, :1], decode=True)["cache"]
+    for pos in range(N):
+        step, vars_ = attn.apply(
+            {"params": params["params"], "cache": cache},
+            x[:, pos : pos + 1],
+            rotary_pos_emb=rot,
+            decode=True,
+            mutable=["cache"],
+        )
+        cache = vars_["cache"]
+        np.testing.assert_allclose(
+            np.asarray(step)[:, 0], full[:, pos], atol=3e-5,
+            err_msg=f"{attn_type} decode pos {pos}",
+        )
+
+
+def test_stable_softmax_path(x):
+    attn = make_attn("full", stable=True)
+    params = attn.init(jax.random.PRNGKey(1), x)
+    out = attn.apply(params, x)
+    ref = attn.apply(params, x)  # determinism
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_non_causal_full():
+    """CLIP-style bidirectional attention: early output depends on later input."""
+    attn = PatternAttention(
+        dim=DIM, seq_len=8, attn_type="full", causal=False, heads=2, dim_head=16
+    )
+    x0 = jax.random.normal(jax.random.PRNGKey(4), (1, 8, DIM))
+    params = attn.init(jax.random.PRNGKey(1), x0)
+    base = np.asarray(attn.apply(params, x0))
+    out = np.asarray(attn.apply(params, x0.at[0, 7].add(1.0)))
+    assert np.abs(out[0, 0] - base[0, 0]).max() > 1e-6

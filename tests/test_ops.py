@@ -1,0 +1,181 @@
+"""Unit tests for core ops: rotary tables, static masks, layer primitives."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.ops import masks, rotary
+from dalle_pytorch_tpu.ops.layers import (
+    divide_max,
+    layer_scale_init,
+    shift_tokens,
+    shift_tokens_decode,
+    stable_softmax,
+)
+
+
+class TestRotary:
+    def test_angle_table_shape(self):
+        # dim_head=64 -> rot_dim=21 -> each part 2*(21//2)=20 wide, 3 parts
+        table = rotary.dalle_rotary_table(64, text_len=9, image_fmap_size=4)
+        assert table.shape == (9 + 16 - 1, 60)
+
+    def test_apply_preserves_norm(self):
+        # rotation is orthogonal on the rotated channels
+        key = jax.random.PRNGKey(0)
+        t = jax.random.normal(key, (2, 3, 8, 64))
+        table = rotary.dalle_rotary_table(64, text_len=5, image_fmap_size=2)
+        out = rotary.apply_rotary_emb(jnp.asarray(table[None, None]), t)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(out), axis=-1),
+            np.linalg.norm(np.asarray(t), axis=-1),
+            rtol=1e-5,
+        )
+
+    def test_relative_property_1d(self):
+        # <q(m), k(n)> after rotation depends only on m - n for 1-D angles
+        freqs = rotary.lang_freqs(16)
+        q = jax.random.normal(jax.random.PRNGKey(1), (16,))
+        k = jax.random.normal(jax.random.PRNGKey(2), (16,))
+
+        def dot(m, n):
+            am = jnp.asarray(rotary.angles(np.array([m]), freqs)[0])
+            an = jnp.asarray(rotary.angles(np.array([n]), freqs)[0])
+            qm = rotary.apply_rotary_emb(am, q)
+            kn = rotary.apply_rotary_emb(an, k)
+            return float(jnp.dot(qm, kn))
+
+        assert dot(3, 1) == pytest.approx(dot(7, 5), rel=1e-5)
+        assert dot(3, 1) != pytest.approx(dot(3, 2), rel=1e-3)
+
+    def test_rotate_half_pairs(self):
+        x = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        np.testing.assert_allclose(
+            np.asarray(rotary.rotate_half(x)), [-2.0, 1.0, -4.0, 3.0]
+        )
+
+
+class TestMasks:
+    text_len, f = 5, 4  # text includes <bos>; 4x4 image grid
+
+    def total(self):
+        return self.text_len + self.f * self.f
+
+    def test_causal(self):
+        m = masks.causal_mask(4)
+        assert m[2, 2] and m[2, 0] and not m[2, 3]
+
+    def test_all_patterns_are_causal_and_self_attending(self):
+        for attn_type in ("full", "axial_row", "axial_col", "conv_like", "sparse"):
+            m = masks.pattern_mask(attn_type, self.text_len, self.f)
+            assert m.shape == (self.total(), self.total())
+            assert not np.triu(m, 1).any(), f"{attn_type} must be causal"
+            assert m.diagonal().all(), f"{attn_type} must attend to self"
+
+    def test_image_attends_all_text(self):
+        for attn_type in ("axial_row", "axial_col", "conv_like"):
+            m = masks.pattern_mask(attn_type, self.text_len, self.f)
+            assert m[self.text_len :, : self.text_len].all()
+
+    def test_axial_row_structure(self):
+        m = masks.axial_mask(self.text_len, self.f, axis=0)
+        tl, f = self.text_len, self.f
+        q = tl + 1 * f + 2  # image (row 1, col 2)
+        assert m[q, tl + 1 * f + 0] and m[q, tl + 1 * f + 2]
+        assert not m[q, tl + 1 * f + 3]  # later col in same row
+        assert not m[q, tl + 0 * f + 2]  # different row
+        assert not m[q, tl + 0 * f + 0]
+
+    def test_axial_col_structure(self):
+        m = masks.axial_mask(self.text_len, self.f, axis=1)
+        tl, f = self.text_len, self.f
+        q = tl + 2 * f + 1  # (row 2, col 1)
+        assert m[q, tl + 0 * f + 1] and m[q, tl + 1 * f + 1]
+        assert not m[q, tl + 3 * f + 1]  # later row same col
+        assert not m[q, tl + 2 * f + 0]  # same row different col
+
+    def test_conv_window(self):
+        m = masks.conv_mask(self.text_len, self.f, kernel_size=3)
+        tl, f = self.text_len, self.f
+        q = tl + 2 * f + 2  # (2, 2)
+        assert m[q, tl + 1 * f + 1]  # diag neighbor above-left
+        assert m[q, tl + 2 * f + 1]  # left
+        assert not m[q, tl + 2 * f + 3]  # right of q (index greater)
+        assert not m[q, tl + 0 * f + 2]  # outside 3x3 window
+
+    def test_block_sparse_global_text(self):
+        total = self.total()
+        m = masks.block_sparse_mask(
+            total, block_size=4, text_seq_len=self.text_len - 1, num_random_blocks=1
+        )
+        # global text blocks: every query sees the first text block (causally)
+        assert all(m[i, 0] for i in range(1, total))
+        assert not np.triu(m, 1).any()
+
+    def test_dilated_conv_window(self):
+        m = masks.conv_mask(2, 8, kernel_size=3, dilation=2)
+        tl, f = 2, 8
+        q = tl + 4 * f + 4
+        assert m[q, tl + 2 * f + 2]  # dilation-2 neighbor
+        assert not m[q, tl + 3 * f + 3]  # odd offset not part of dilated grid
+
+
+class TestLayers:
+    def test_stable_softmax_matches_softmax(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 16)) * 5
+        np.testing.assert_allclose(
+            np.asarray(stable_softmax(x)),
+            np.asarray(jax.nn.softmax(x, axis=-1)),
+            atol=1e-6,
+        )
+
+    def test_divide_max(self):
+        x = jnp.asarray([[1.0, 2.0, 4.0]])
+        np.testing.assert_allclose(np.asarray(divide_max(x)), [[0.25, 0.5, 1.0]])
+
+    def test_layer_scale_init_schedule(self):
+        assert layer_scale_init(1) == 0.1
+        assert layer_scale_init(18) == 0.1
+        assert layer_scale_init(19) == 1e-5
+        assert layer_scale_init(24) == 1e-5
+        assert layer_scale_init(25) == 1e-6
+
+    def test_shift_tokens_semantics(self):
+        b, d, f, text_len = 1, 8, 3, 3
+        n = text_len + f * f - 1  # truncated final token, like training
+        x = jax.random.normal(jax.random.PRNGKey(0), (b, n, d))
+        out = shift_tokens(x, text_len, f)
+        assert out.shape == x.shape
+        x, out = np.asarray(x), np.asarray(out)
+        half, q = d // 2, d // 4
+        # text position 0: first half zeros
+        np.testing.assert_allclose(out[0, 0, :half], 0.0)
+        np.testing.assert_allclose(out[0, 0, half:], x[0, 0, half:])
+        # text position 2: first half from position 1
+        np.testing.assert_allclose(out[0, 2, :half], x[0, 1, :half])
+        # image grid position (1, 1) = seq index text_len + 4 (f=3 grid):
+        p = text_len + 4
+        np.testing.assert_allclose(out[0, p, :q], x[0, p - f, :q])  # from above
+        np.testing.assert_allclose(out[0, p, q : 2 * q], x[0, p - 1, q : 2 * q])  # left
+        np.testing.assert_allclose(out[0, p, 2 * q :], x[0, p, 2 * q :])
+        # image grid position (0, 0): top and left quarters zero
+        p0 = text_len
+        np.testing.assert_allclose(out[0, p0, : 2 * q], 0.0)
+
+    def test_shift_tokens_decode_matches_batch(self):
+        """The per-token decode shift must agree with the full-sequence shift."""
+        b, d, f, text_len = 2, 8, 3, 4
+        n = text_len + f * f
+        x = jax.random.normal(jax.random.PRNGKey(1), (b, n, d))
+        full = np.asarray(shift_tokens(x, text_len, f))
+        zeros = jnp.zeros((b, 1, d))
+        for pos in range(n):
+            prev = x[:, pos - 1 : pos] if pos > 0 else zeros
+            ra = x[:, pos - f : pos - f + 1] if pos - f >= 0 else zeros
+            step = shift_tokens_decode(
+                x[:, pos : pos + 1], jnp.asarray(pos), prev, ra, text_len, f
+            )
+            np.testing.assert_allclose(
+                np.asarray(step)[:, 0], full[:, pos], atol=1e-6, err_msg=f"pos={pos}"
+            )
