@@ -1,3 +1,28 @@
+from .clip import CLIP, masked_mean
+from .dalle import DALLE, top_k_filter
+from .sampling import (
+    decode_tokens,
+    generate_image_tokens,
+    generate_images,
+    generate_texts,
+    init_decode_cache,
+)
 from .transformer import Transformer
+from .vae import DiscreteVAE, ResBlock, gumbel_softmax, smooth_l1_loss
 
-__all__ = ["Transformer"]
+__all__ = [
+    "CLIP",
+    "DALLE",
+    "DiscreteVAE",
+    "ResBlock",
+    "Transformer",
+    "decode_tokens",
+    "generate_image_tokens",
+    "generate_images",
+    "generate_texts",
+    "gumbel_softmax",
+    "init_decode_cache",
+    "masked_mean",
+    "smooth_l1_loss",
+    "top_k_filter",
+]

@@ -1,0 +1,150 @@
+"""CLIP dual-encoder for generation reranking, TPU-native.
+
+Capability parity with the reference's ``CLIP`` (dalle_pytorch.py:229-305):
+text transformer + ViT-style patch image transformer (both non-causal, no
+rotary), masked-mean / mean pooling, bias-free latent projections, L2
+normalization and a learned temperature; training mode is the symmetric
+InfoNCE cross-entropy over the batch. Patchify is a reshape/transpose (XLA
+fuses it into the first matmul), not a conv.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .transformer import Transformer
+
+Dtype = Any
+
+
+def masked_mean(t: jnp.ndarray, mask: jnp.ndarray, axis: int = 1) -> jnp.ndarray:
+    """Mean over ``axis`` counting only True positions (reference
+    dalle_pytorch.py:31-33)."""
+    t = jnp.where(mask[..., None], t, 0.0)
+    return t.sum(axis=axis) / mask.sum(axis=axis)[..., None]
+
+
+class CLIP(nn.Module):
+    dim_text: int = 512
+    dim_image: int = 512
+    dim_latent: int = 512
+    num_text_tokens: int = 10000
+    text_enc_depth: int = 6
+    text_seq_len: int = 256
+    text_heads: int = 8
+    num_visual_tokens: int = 512
+    visual_enc_depth: int = 6
+    visual_heads: int = 8
+    visual_image_size: int = 256
+    visual_patch_size: int = 32
+    channels: int = 3
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @property
+    def num_patches(self) -> int:
+        assert self.visual_image_size % self.visual_patch_size == 0, (
+            "Image dimensions must be divisible by the patch size."
+        )
+        return (self.visual_image_size // self.visual_patch_size) ** 2
+
+    def setup(self):
+        self.text_emb = nn.Embed(self.num_text_tokens, self.dim_text, param_dtype=self.param_dtype)
+        self.text_pos_emb = nn.Embed(self.text_seq_len, self.dim_text, param_dtype=self.param_dtype)
+        self.text_transformer = Transformer(
+            dim=self.dim_text,
+            depth=self.text_enc_depth,
+            seq_len=self.text_seq_len,
+            causal=False,
+            heads=self.text_heads,
+            dim_head=self.dim_text // self.text_heads,
+            rotary_emb=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        self.to_text_latent = nn.Dense(
+            self.dim_latent, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype
+        )
+
+        self.to_visual_embedding = nn.Dense(
+            self.dim_image, dtype=self.dtype, param_dtype=self.param_dtype
+        )
+        self.visual_pos_emb = nn.Embed(
+            self.num_patches, self.dim_image, param_dtype=self.param_dtype
+        )
+        self.visual_transformer = Transformer(
+            dim=self.dim_image,
+            depth=self.visual_enc_depth,
+            seq_len=self.num_patches,
+            causal=False,
+            heads=self.visual_heads,
+            dim_head=self.dim_image // self.visual_heads,
+            rotary_emb=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        self.to_visual_latent = nn.Dense(
+            self.dim_latent, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype
+        )
+
+        self.temperature = self.param(
+            "temperature", nn.initializers.ones, (), self.param_dtype
+        )
+
+    def patchify(self, image: jnp.ndarray) -> jnp.ndarray:
+        """(b, h, w, c) NHWC -> (b, num_patches, p*p*c)."""
+        p = self.visual_patch_size
+        b, h, w, c = image.shape
+        image = image.reshape(b, h // p, p, w // p, p, c)
+        return image.transpose(0, 1, 3, 2, 4, 5).reshape(b, (h // p) * (w // p), p * p * c)
+
+    def __call__(
+        self,
+        text: jnp.ndarray,
+        image: jnp.ndarray,
+        text_mask: Optional[jnp.ndarray] = None,
+        return_loss: bool = False,
+        deterministic: bool = True,
+    ):
+        """text: (b, text_seq_len) int ids; image: (b, h, w, c) pixels.
+        Returns per-pair similarity (b,) or the symmetric CE loss."""
+        b = text.shape[0]
+        text_tokens = self.text_emb(text) + self.text_pos_emb(jnp.arange(text.shape[1]))[None]
+
+        image_patches = self.patchify(image.astype(self.dtype))
+        image_tokens = self.to_visual_embedding(image_patches)
+        image_tokens = image_tokens + self.visual_pos_emb(jnp.arange(image_tokens.shape[1]))[None]
+
+        enc_text = self.text_transformer(
+            text_tokens.astype(self.dtype), mask=text_mask, deterministic=deterministic
+        )
+        enc_image = self.visual_transformer(image_tokens, deterministic=deterministic)
+
+        if text_mask is not None:
+            text_latents = masked_mean(enc_text, text_mask, axis=1)
+        else:
+            text_latents = enc_text.mean(axis=1)
+        image_latents = enc_image.mean(axis=1)
+
+        text_latents = self.to_text_latent(text_latents).astype(jnp.float32)
+        image_latents = self.to_visual_latent(image_latents).astype(jnp.float32)
+
+        text_latents = text_latents / jnp.linalg.norm(text_latents, axis=-1, keepdims=True)
+        image_latents = image_latents / jnp.linalg.norm(image_latents, axis=-1, keepdims=True)
+
+        temp = jnp.exp(self.temperature)
+
+        if not return_loss:
+            return jnp.einsum("nd,nd->n", text_latents, image_latents) * temp
+
+        sim = jnp.einsum("id,jd->ij", text_latents, image_latents) * temp
+        labels = jnp.arange(b)
+        logp_t = jax.nn.log_softmax(sim, axis=-1)
+        logp_i = jax.nn.log_softmax(sim.T, axis=-1)
+        loss_t = -jnp.take_along_axis(logp_t, labels[:, None], axis=-1).mean()
+        loss_i = -jnp.take_along_axis(logp_i, labels[:, None], axis=-1).mean()
+        return (loss_t + loss_i) / 2

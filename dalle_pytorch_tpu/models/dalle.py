@@ -1,0 +1,276 @@
+"""DALL-E: joint text->image autoregressive transformer, TPU-native.
+
+Capability parity with the reference's ``DALLE`` (dalle_pytorch.py:309-585):
+per-position unique padding tokens, <bos> prepend, text/image embedding concat,
+static text-vs-image logits mask, and the weighted split cross-entropy loss —
+rebuilt as a functional flax module:
+
+- the model consumes **image token ids**, not raw pixels: VAE encode is a
+  frozen no-grad lookup in the reference (dalle_pytorch.py:533-540) and lives
+  outside the trained graph here (trainers call ``vae.get_codebook_indices``
+  under ``stop_gradient`` and feed tokens), so the VAE is never entangled in
+  the DALLE parameter pytree;
+- the logits mask is a static numpy constant baked at trace time
+  (reference registers a buffer, dalle_pytorch.py:388-399);
+- ``decode_step`` runs one token through the KV-cached transformer for
+  O(seq) per-token sampling — the reference re-runs the full prefix per token
+  (dalle_pytorch.py:481-486).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.linen as nn
+
+from ..ops.layers import AxialPositionalEmbedding, divide_max
+from .transformer import Transformer
+
+Dtype = Any
+
+NEG_INF = -0.7 * float(np.finfo(np.float32).max)
+
+
+def top_k_filter(logits: jnp.ndarray, thres: float = 0.5) -> jnp.ndarray:
+    """Keep the top ``max(int((1-thres)*vocab), 1)`` logits, fill the rest with
+    -inf (reference top_k, dalle_pytorch.py:50-56)."""
+    num_logits = logits.shape[-1]
+    k = max(int((1 - thres) * num_logits), 1)
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+class DALLE(nn.Module):
+    """Text+image autoregressive LM over a mixed discrete vocabulary.
+
+    ``num_text_tokens`` is the raw text vocab; internally it is extended by
+    ``text_seq_len`` per-position padding ids (reference dalle_pytorch.py:338).
+    """
+
+    dim: int
+    depth: int
+    num_text_tokens: int = 10000
+    text_seq_len: int = 256
+    num_image_tokens: int = 512
+    image_fmap_size: int = 32
+    heads: int = 8
+    dim_head: int = 64
+    reversible: bool = False
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    attn_types: Optional[Tuple[str, ...]] = None
+    loss_img_weight: float = 7.0
+    stable: bool = False
+    shift_tokens: bool = True
+    rotary_emb: bool = True
+    remat: bool = False
+    sparse_layout_seed: int = 0
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    # ------------------------------------------------------------ derived
+
+    @property
+    def image_seq_len(self) -> int:
+        return self.image_fmap_size**2
+
+    @property
+    def num_text_tokens_ext(self) -> int:
+        return self.num_text_tokens + self.text_seq_len
+
+    @property
+    def total_tokens(self) -> int:
+        return self.num_text_tokens_ext + self.num_image_tokens
+
+    @property
+    def total_seq_len(self) -> int:
+        """Transformer input length (last token never fed, reference
+        dalle_pytorch.py:554-556)."""
+        return self.text_seq_len + self.image_seq_len
+
+    @property
+    def text_len_internal(self) -> int:
+        """Text positions including <bos>."""
+        return self.text_seq_len + 1
+
+    def logits_mask_np(self) -> np.ndarray:
+        """(total_seq_len, total_tokens) bool, True = FORBIDDEN: text positions
+        may only predict text tokens, image positions image tokens (reference
+        dalle_pytorch.py:388-399)."""
+        seq = np.arange(self.total_seq_len)[:, None]
+        logit = np.arange(self.total_tokens)[None, :]
+        return ((seq >= self.text_seq_len) & (logit < self.num_text_tokens_ext)) | (
+            (seq < self.text_seq_len) & (logit >= self.num_text_tokens_ext)
+        )
+
+    # -------------------------------------------------------------- setup
+
+    def setup(self):
+        self.text_emb = nn.Embed(
+            self.num_text_tokens_ext, self.dim, param_dtype=self.param_dtype
+        )
+        self.image_emb = nn.Embed(
+            self.num_image_tokens, self.dim, param_dtype=self.param_dtype
+        )
+        if not self.rotary_emb:
+            self.text_pos_emb = nn.Embed(
+                self.text_len_internal, self.dim, param_dtype=self.param_dtype
+            )
+            self.image_pos_emb = AxialPositionalEmbedding(
+                dim=self.dim,
+                shape=(self.image_fmap_size, self.image_fmap_size),
+                param_dtype=self.param_dtype,
+            )
+
+        self.transformer = Transformer(
+            dim=self.dim,
+            depth=self.depth,
+            seq_len=self.total_seq_len,
+            reversible=self.reversible,
+            causal=True,
+            heads=self.heads,
+            dim_head=self.dim_head,
+            attn_dropout=self.attn_dropout,
+            ff_dropout=self.ff_dropout,
+            attn_types=self.attn_types,
+            image_fmap_size=self.image_fmap_size,
+            stable=self.stable,
+            shift_tokens=self.shift_tokens,
+            rotary_emb=self.rotary_emb,
+            remat=self.remat,
+            sparse_layout_seed=self.sparse_layout_seed,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        self.final_norm = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype)
+        self.to_logits = nn.Dense(
+            self.total_tokens, dtype=jnp.float32, param_dtype=self.param_dtype
+        )
+
+    # ------------------------------------------------------------- helpers
+
+    def remap_text(self, text: jnp.ndarray) -> jnp.ndarray:
+        """Give each padding-0 text position its own unique token id and
+        prepend <bos>=0 (reference dalle_pytorch.py:521-526)."""
+        text_range = jnp.arange(self.text_seq_len, dtype=text.dtype) + (
+            self.num_text_tokens_ext - self.text_seq_len
+        )
+        text = jnp.where(text == 0, text_range, text)
+        return jnp.pad(text, ((0, 0), (1, 0)))  # <bos> = 0
+
+    def _full_key_mask(self, mask: Optional[jnp.ndarray], n: int) -> Optional[jnp.ndarray]:
+        """Text padding mask (b, text_seq_len) -> (b, n) key mask over the
+        internal [bos, text, image] sequence."""
+        if mask is None:
+            return None
+        b = mask.shape[0]
+        bos = jnp.ones((b, 1), dtype=bool)
+        img = jnp.ones((b, self.image_seq_len), dtype=bool)
+        return jnp.concatenate((bos, mask, img), axis=1)[:, :n]
+
+    def _head(self, out: jnp.ndarray) -> jnp.ndarray:
+        if self.stable:
+            out = divide_max(out)
+        return self.to_logits(self.final_norm(out))
+
+    # ------------------------------------------------------------- forward
+
+    def __call__(
+        self,
+        text: jnp.ndarray,
+        image: Optional[jnp.ndarray] = None,
+        mask: Optional[jnp.ndarray] = None,
+        return_loss: bool = False,
+        deterministic: bool = True,
+    ):
+        """text: (b, text_seq_len) int ids; image: (b, <=image_seq_len) token
+        ids in [0, num_image_tokens). Returns logits (b, n, total_tokens) or
+        the weighted CE loss (reference dalle_pytorch.py:509-585)."""
+        assert text.shape[-1] == self.text_seq_len, (
+            f"text length {text.shape[-1]} != text_seq_len {self.text_seq_len}"
+        )
+        text = self.remap_text(text)
+        tokens = self.text_emb(text)
+        if not self.rotary_emb:
+            tokens = tokens + self.text_pos_emb(jnp.arange(self.text_len_internal))[None]
+
+        if image is not None and image.shape[1] > 0:
+            image_tokens = self.image_emb(image)
+            if not self.rotary_emb:
+                image_tokens = image_tokens + self.image_pos_emb(image_tokens)
+            tokens = jnp.concatenate((tokens, image_tokens), axis=1)
+
+        # drop the trailing token: it never predicts anything
+        if tokens.shape[1] > self.total_seq_len:
+            tokens = tokens[:, : self.total_seq_len]
+        n = tokens.shape[1]
+
+        out = self.transformer(
+            tokens.astype(self.dtype),
+            mask=self._full_key_mask(mask, n),
+            deterministic=deterministic,
+        )
+        logits = self._head(out)
+        logits = jnp.where(
+            jnp.asarray(self.logits_mask_np()[:n])[None], NEG_INF, logits
+        )
+
+        if not return_loss:
+            return logits
+
+        assert image is not None, "when training, image tokens must be supplied"
+        labels = jnp.concatenate(
+            (text[:, 1:], image + self.num_text_tokens_ext), axis=1
+        )
+        logprobs = jax.nn.log_softmax(logits, axis=-1)
+        token_ll = jnp.take_along_axis(logprobs, labels[..., None], axis=-1)[..., 0]
+        loss_text = -token_ll[:, : self.text_seq_len].mean()
+        loss_img = -token_ll[:, self.text_seq_len :].mean()
+        return (loss_text + self.loss_img_weight * loss_img) / (self.loss_img_weight + 1)
+
+    # --------------------------------------------------------------- decode
+
+    def decode_step(
+        self,
+        token: jnp.ndarray,
+        pos: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """One KV-cached decode step.
+
+        token: (b,) id of the token at internal position ``pos`` — a remapped
+        text id (bos included) when pos < text_len_internal, otherwise an
+        un-offset image token id. Returns (b, total_tokens) logits predicting
+        position pos+1. The transformer's cache collections must be mutable.
+        """
+        b = token.shape[0]
+        is_text = pos < self.text_len_internal
+
+        text_tok = jnp.clip(token, 0, self.num_text_tokens_ext - 1)
+        img_tok = jnp.clip(token, 0, self.num_image_tokens - 1)
+        emb = jnp.where(
+            is_text, self.text_emb(text_tok), self.image_emb(img_tok)
+        )
+        if not self.rotary_emb:
+            tpos = jnp.clip(pos, 0, self.text_len_internal - 1)
+            ipos = jnp.clip(pos - self.text_len_internal, 0, self.image_seq_len - 1)
+            img_grid = self.image_pos_emb(jnp.zeros((1, self.image_seq_len, self.dim)))
+            emb = emb + jnp.where(
+                is_text,
+                self.text_pos_emb(tpos)[None],
+                jax.lax.dynamic_slice_in_dim(img_grid[0], ipos, 1, axis=0),
+            )
+
+        x = emb[:, None, :].astype(self.dtype)
+        out = self.transformer(
+            x, mask=self._full_key_mask(mask, self.text_len_internal + self.image_seq_len),
+            deterministic=True, decode=True,
+        )
+        logits = self._head(out)[:, 0]
+        mask_row = jax.lax.dynamic_slice_in_dim(
+            jnp.asarray(self.logits_mask_np()), jnp.minimum(pos, self.total_seq_len - 1), 1, axis=0
+        )
+        return jnp.where(mask_row, NEG_INF, logits)

@@ -1,0 +1,214 @@
+"""Autoregressive sampling for DALL-E, TPU-native.
+
+The reference samples by re-running the full forward pass over the whole
+prefix for every generated token (dalle_pytorch.py:481-486) — O(L^2) attention
+work per token. Here generation is a single ``lax.scan`` over the KV-cached
+``DALLE.decode_step``: every step costs one (1 x L) attention per layer, the
+whole sequence compiles to one XLA program, and prompt prefill is just
+teacher-forcing the scan's first ``known_len`` steps. Randomness flows through
+explicit PRNG keys; top-k fractional-threshold filtering, temperature,
+image-token priming (reference dalle_pytorch.py:470-479) and CLIP reranking
+(dalle_pytorch.py:503-505) all match the reference semantics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .dalle import DALLE, top_k_filter
+
+
+def init_decode_cache(dalle: DALLE, params, batch_size: int):
+    """Materialize the transformer's KV/shift caches for a batch."""
+    token = jnp.zeros((batch_size,), dtype=jnp.int32)
+    _, mutated = dalle.apply(
+        {"params": params},
+        token,
+        jnp.array(0, jnp.int32),
+        method=DALLE.decode_step,
+        mutable=["cache"],
+    )
+    return mutated["cache"]
+
+
+@partial(jax.jit, static_argnums=(0, 3, 5, 8))
+def decode_tokens(
+    dalle: DALLE,
+    params,
+    tokens: jnp.ndarray,
+    known_len: int,
+    key: jax.Array,
+    filter_thres: float = 0.5,
+    temperature: float = 1.0,
+    mask: Optional[jnp.ndarray] = None,
+    num_steps: Optional[int] = None,
+):
+    """Run the decode scan over the internal token buffer.
+
+    tokens: (b, n_internal) int32 — position 0 is <bos>; the first
+    ``known_len`` positions are prompt (teacher-forced), the rest are filled by
+    sampling. Text positions hold remapped text ids, image positions hold
+    un-offset image token ids. Scans ``num_steps`` (default n_internal - 1)
+    input positions and returns the completed buffer.
+    """
+    b, n_internal = tokens.shape
+    steps = n_internal - 1 if num_steps is None else num_steps
+    text_len_internal = dalle.text_len_internal
+    ext = dalle.num_text_tokens_ext
+
+    cache = init_decode_cache(dalle, params, b)
+
+    def step(carry, i):
+        cache, tokens, key = carry
+        tok_in = jax.lax.dynamic_slice_in_dim(tokens, i, 1, axis=1)[:, 0]
+        logits, mutated = dalle.apply(
+            {"params": params, "cache": cache},
+            tok_in,
+            i,
+            mask,
+            method=DALLE.decode_step,
+            mutable=["cache"],
+        )
+        key, sub = jax.random.split(key)
+        filtered = top_k_filter(logits, thres=filter_thres)
+        sample = jax.random.categorical(sub, filtered / temperature, axis=-1)
+
+        nxt = i + 1
+        sample = jnp.where(nxt >= text_len_internal, sample - ext, sample)
+        prev = jax.lax.dynamic_slice_in_dim(tokens, nxt, 1, axis=1)[:, 0]
+        new_val = jnp.where(nxt < known_len, prev, sample).astype(tokens.dtype)
+        tokens = jax.lax.dynamic_update_slice(tokens, new_val[:, None], (0, nxt))
+        return (mutated["cache"], tokens, key), None
+
+    (_, tokens, _), _ = jax.lax.scan(
+        step, (cache, tokens, key), jnp.arange(steps, dtype=jnp.int32)
+    )
+    return tokens
+
+
+def generate_image_tokens(
+    dalle: DALLE,
+    params,
+    text: jnp.ndarray,
+    key: jax.Array,
+    *,
+    filter_thres: float = 0.5,
+    temperature: float = 1.0,
+    prime_tokens: Optional[jnp.ndarray] = None,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """text: (b, text_seq_len) raw ids -> sampled image token ids
+    (b, image_seq_len)."""
+    b = text.shape[0]
+    text = text[:, : dalle.text_seq_len].astype(jnp.int32)
+    # remap_text touches no params, so the unbound-module call is safe
+    internal_text = dalle.remap_text(text)
+
+    n_internal = dalle.text_len_internal + dalle.image_seq_len
+    tokens = jnp.zeros((b, n_internal), dtype=jnp.int32)
+    tokens = jax.lax.dynamic_update_slice(tokens, internal_text, (0, 0))
+
+    known_len = dalle.text_len_internal
+    if prime_tokens is not None:
+        assert prime_tokens.shape[1] < dalle.image_seq_len, (
+            "number of priming image tokens must be < image_seq_len"
+        )
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, prime_tokens.astype(jnp.int32), (0, dalle.text_len_internal)
+        )
+        known_len += int(prime_tokens.shape[1])
+
+    tokens = decode_tokens(
+        dalle, params, tokens, known_len, key,
+        filter_thres=filter_thres, temperature=temperature, mask=mask,
+    )
+    return tokens[:, dalle.text_len_internal :]
+
+
+def generate_images(
+    dalle: DALLE,
+    params,
+    vae,
+    vae_variables,
+    text: jnp.ndarray,
+    key: jax.Array,
+    *,
+    clip=None,
+    clip_variables=None,
+    mask: Optional[jnp.ndarray] = None,
+    filter_thres: float = 0.5,
+    temperature: float = 1.0,
+    img: Optional[jnp.ndarray] = None,
+    num_init_img_tokens: Optional[int] = None,
+):
+    """Full text -> pixels pipeline (reference generate_images,
+    dalle_pytorch.py:451-507): optional image priming with
+    ``int(0.4375 * image_seq_len)`` tokens, scan-decode, VAE decode, optional
+    CLIP rerank. ``vae`` / ``clip`` are flax modules sharing the reference's
+    duck-type (get_codebook_indices / decode; __call__ similarity)."""
+    text = text[:, : dalle.text_seq_len]  # rerank sees the same truncated text
+    prime = None
+    if img is not None:
+        indices = vae.apply(vae_variables, img, method=type(vae).get_codebook_indices)
+        n_prime = (
+            int(0.4375 * dalle.image_seq_len)
+            if num_init_img_tokens is None
+            else num_init_img_tokens
+        )
+        prime = indices[:, :n_prime]
+
+    img_seq = generate_image_tokens(
+        dalle, params, text, key,
+        filter_thres=filter_thres, temperature=temperature,
+        prime_tokens=prime, mask=mask,
+    )
+    images = vae.apply(vae_variables, img_seq, method=type(vae).decode)
+
+    if clip is not None:
+        scores = clip.apply(clip_variables, text, images)
+        return images, scores
+    return images
+
+
+def generate_texts(
+    dalle: DALLE,
+    params,
+    key: jax.Array,
+    prompt_tokens: Optional[jnp.ndarray] = None,
+    *,
+    filter_thres: float = 0.5,
+    temperature: float = 1.0,
+    tokenizer=None,
+):
+    """Text-only completion (reference generate_texts,
+    dalle_pytorch.py:403-449): start from <bos> (plus an optional encoded
+    prompt) and sample out to text_seq_len tokens. Returns (tokens, texts) —
+    texts only when a tokenizer with pad-aware decode is supplied."""
+    if prompt_tokens is None:
+        prompt_tokens = jnp.zeros((1, 1), dtype=jnp.int32)
+    b, p = prompt_tokens.shape
+
+    tokens = jnp.zeros((b, dalle.text_len_internal + dalle.image_seq_len), jnp.int32)
+    tokens = jax.lax.dynamic_update_slice(tokens, prompt_tokens.astype(jnp.int32), (0, 0))
+
+    tokens = decode_tokens(
+        dalle, params, tokens, p, key,
+        filter_thres=filter_thres, temperature=temperature,
+        num_steps=dalle.text_seq_len - 1,
+    )
+    text_tokens = tokens[:, : dalle.text_seq_len]
+
+    if tokenizer is None:
+        return text_tokens, None
+    pad_tokens = set(
+        range(dalle.num_text_tokens_ext - dalle.text_seq_len, dalle.num_text_tokens_ext)
+    )
+    texts = [
+        tokenizer.decode([int(t) for t in row], pad_tokens=pad_tokens)
+        for row in text_tokens
+    ]
+    return text_tokens, texts

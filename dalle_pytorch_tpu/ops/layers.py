@@ -184,6 +184,35 @@ class PreShiftToken(nn.Module):
         return self.fn(x, **inner_kwargs)
 
 
+class AxialPositionalEmbedding(nn.Module):
+    """Factorized 2-D learned position embedding over the image grid.
+
+    Re-owns the external ``axial_positional_embedding`` package the reference
+    pulls in (dalle_pytorch.py:7,343-344): one (rows, dim) and one (cols, dim)
+    parameter whose broadcast sum covers the full grid — O(2·f·d) parameters
+    instead of O(f²·d).
+    """
+
+    dim: int
+    shape: tuple  # (rows, cols)
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        """x: (b, n, d) image-token embeddings with n <= rows * cols; returns
+        the first n grid position embeddings, broadcast over the batch."""
+        rows, cols = self.shape
+        row_emb = self.param(
+            "row_emb", nn.initializers.normal(1.0), (rows, 1, self.dim), self.param_dtype
+        )
+        col_emb = self.param(
+            "col_emb", nn.initializers.normal(1.0), (1, cols, self.dim), self.param_dtype
+        )
+        grid = (row_emb + col_emb).reshape(rows * cols, self.dim)
+        n = x.shape[1]
+        return grid[None, :n].astype(x.dtype)
+
+
 class SpatialGatingUnit(nn.Module):
     """gMLP spatial gating (arXiv:2105.08050; the reference pulls this in from
     the external g-mlp-pytorch package for attn_type='mlp',
