@@ -1,0 +1,138 @@
+"""Headline benchmark: flagship DALL-E train-step MFU on one chip.
+
+Config matches BASELINE.md's target row — DALLE depth=12 / dim=1024 /
+256 text + 1024 image tokens (the reference's train_dalle.py hot loop,
+SURVEY.md §3.1) — compiled as one jitted train step in bf16.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": MFU, "unit": "fraction", "vs_baseline": MFU/0.45, ...}
+vs_baseline is against the driver's >=45%-MFU north-star target
+(BASELINE.json); the reference itself publishes no numbers (BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo") if "/root/repo" not in sys.path else None
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+# bf16 peak FLOP/s per chip by device kind (v5e = 197 TF)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v6 lite": 918e12,
+    "cpu": 5e11,
+}
+
+DEPTH, DIM, HEADS, DIM_HEAD = 12, 1024, 16, 64
+TEXT_SEQ, IMAGE_FMAP = 256, 32
+NUM_TEXT, NUM_IMAGE = 10000, 8192
+BATCH = 8
+
+
+def peak_flops() -> float:
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_FLOPS.items():
+        if k.lower() in kind.lower():
+            return v
+    return 197e12
+
+
+def model_flops_per_step(batch: int, depth: int = DEPTH) -> float:
+    """Analytic fwd+bwd matmul FLOPs per train step (3x forward)."""
+    n = TEXT_SEQ + IMAGE_FMAP**2  # 1280
+    total_tokens = NUM_TEXT + TEXT_SEQ + NUM_IMAGE
+    per_layer_params = 16 * DIM * DIM  # qkv 3d² + out d² + GEGLU 12d²
+    matmul_params = depth * per_layer_params + DIM * total_tokens
+    fwd = 2 * batch * n * matmul_params  # dense matmuls
+    fwd += depth * 4 * batch * n * n * (HEADS * DIM_HEAD)  # QK^T + AV
+    return 3 * fwd
+
+
+def main():
+    from dalle_pytorch_tpu.models import DALLE
+    from dalle_pytorch_tpu.parallel import create_train_state, make_runtime, make_train_step
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    batch = 2 if on_cpu else BATCH
+    depth = 2 if on_cpu else DEPTH
+
+    dalle = DALLE(
+        dim=DIM,
+        depth=depth,
+        num_text_tokens=NUM_TEXT,
+        text_seq_len=TEXT_SEQ,
+        num_image_tokens=NUM_IMAGE,
+        image_fmap_size=IMAGE_FMAP,
+        heads=HEADS,
+        dim_head=DIM_HEAD,
+        attn_types=("full",),
+        dtype=jnp.bfloat16,
+    )
+    rng = np.random.RandomState(0)
+    batch_data = {
+        "text": jnp.asarray(rng.randint(1, NUM_TEXT, size=(batch, TEXT_SEQ)), jnp.int32),
+        "image": jnp.asarray(
+            rng.randint(0, NUM_IMAGE, size=(batch, IMAGE_FMAP**2)), jnp.int32
+        ),
+    }
+
+    runtime = make_runtime(devices=jax.devices()[:1])
+    params = jax.jit(dalle.init)(
+        jax.random.key(0), batch_data["text"], batch_data["image"]
+    )["params"]
+    opt = optax.chain(optax.clip_by_global_norm(0.5), optax.adam(3e-4))
+    state, shardings = create_train_state(params, opt, runtime)
+
+    def loss_fn(p, b, rng):
+        return dalle.apply({"params": p}, b["text"], b["image"], return_loss=True)
+
+    step = make_train_step(loss_fn, opt, runtime, shardings)
+
+    # warmup / compile; float() forces a real device->host sync (some
+    # remote-execution transports complete block_until_ready early)
+    for i in range(3):
+        state, loss = step(state, batch_data, jax.random.key(i))
+    float(loss)
+
+    n_steps = 3 if on_cpu else 20
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        state, loss = step(state, batch_data, jax.random.key(i))
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    step_time = dt / n_steps
+    flops = model_flops_per_step(batch, depth)
+    mfu = flops / step_time / peak_flops()
+    image_tokens_per_sec = batch * IMAGE_FMAP**2 / step_time
+    samples_per_sec = batch / step_time
+
+    print(
+        json.dumps(
+            {
+                "metric": "train_mfu_dalle_depth12_dim1024_seq1280_1chip",
+                "value": round(float(mfu), 4),
+                "unit": "fraction_of_peak_bf16",
+                "vs_baseline": round(float(mfu) / 0.45, 4),
+                "image_tokens_per_sec_per_chip": round(image_tokens_per_sec, 1),
+                "samples_per_sec": round(samples_per_sec, 2),
+                "step_time_ms": round(step_time * 1e3, 2),
+                "batch": batch,
+                "depth": depth,
+                "device": jax.devices()[0].device_kind,
+                "loss": round(float(loss), 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -200,7 +200,9 @@ class DALLE(nn.Module):
         if image is not None and image.shape[1] > 0:
             image_tokens = self.image_emb(image)
             if not self.rotary_emb:
-                image_tokens = image_tokens + self.image_pos_emb(image_tokens)
+                image_tokens = image_tokens + self.image_pos_emb(
+                    image_tokens.shape[1]
+                ).astype(image_tokens.dtype)
             tokens = jnp.concatenate((tokens, image_tokens), axis=1)
 
         # drop the trailing token: it never predicts anything
@@ -222,6 +224,10 @@ class DALLE(nn.Module):
             return logits
 
         assert image is not None, "when training, image tokens must be supplied"
+        assert image.shape[1] == self.image_seq_len, (
+            f"the loss needs the full image sequence, got {image.shape[1]} of "
+            f"{self.image_seq_len} tokens"
+        )
         labels = jnp.concatenate(
             (text[:, 1:], image + self.num_text_tokens_ext), axis=1
         )
@@ -257,12 +263,12 @@ class DALLE(nn.Module):
         if not self.rotary_emb:
             tpos = jnp.clip(pos, 0, self.text_len_internal - 1)
             ipos = jnp.clip(pos - self.text_len_internal, 0, self.image_seq_len - 1)
-            img_grid = self.image_pos_emb(jnp.zeros((1, self.image_seq_len, self.dim)))
+            img_grid = self.image_pos_emb(self.image_seq_len)
             emb = emb + jnp.where(
                 is_text,
                 self.text_pos_emb(tpos)[None],
                 jax.lax.dynamic_slice_in_dim(img_grid[0], ipos, 1, axis=0),
-            )
+            ).astype(emb.dtype)
 
         x = emb[:, None, :].astype(self.dtype)
         out = self.transformer(

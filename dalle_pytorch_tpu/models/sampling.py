@@ -35,7 +35,7 @@ def init_decode_cache(dalle: DALLE, params, batch_size: int):
     return mutated["cache"]
 
 
-@partial(jax.jit, static_argnums=(0, 3, 5, 8))
+@partial(jax.jit, static_argnums=(0, 5, 8))
 def decode_tokens(
     dalle: DALLE,
     params,
@@ -51,9 +51,10 @@ def decode_tokens(
 
     tokens: (b, n_internal) int32 — position 0 is <bos>; the first
     ``known_len`` positions are prompt (teacher-forced), the rest are filled by
-    sampling. Text positions hold remapped text ids, image positions hold
-    un-offset image token ids. Scans ``num_steps`` (default n_internal - 1)
-    input positions and returns the completed buffer.
+    sampling. ``known_len`` is traced, so varying prompt/prime lengths reuse
+    one compilation. Text positions hold remapped text ids, image positions
+    hold un-offset image token ids. Scans ``num_steps`` (default
+    n_internal - 1) input positions and returns the completed buffer.
     """
     b, n_internal = tokens.shape
     steps = n_internal - 1 if num_steps is None else num_steps

@@ -198,9 +198,9 @@ class AxialPositionalEmbedding(nn.Module):
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
-        """x: (b, n, d) image-token embeddings with n <= rows * cols; returns
-        the first n grid position embeddings, broadcast over the batch."""
+    def __call__(self, n: int):
+        """Return the first ``n`` grid position embeddings, shape (1, n, dim)
+        in param dtype (n <= rows * cols)."""
         rows, cols = self.shape
         row_emb = self.param(
             "row_emb", nn.initializers.normal(1.0), (rows, 1, self.dim), self.param_dtype
@@ -209,8 +209,7 @@ class AxialPositionalEmbedding(nn.Module):
             "col_emb", nn.initializers.normal(1.0), (1, cols, self.dim), self.param_dtype
         )
         grid = (row_emb + col_emb).reshape(rows * cols, self.dim)
-        n = x.shape[1]
-        return grid[None, :n].astype(x.dtype)
+        return grid[None, :n]
 
 
 class SpatialGatingUnit(nn.Module):

@@ -1,0 +1,25 @@
+from .mesh import AXIS_NAMES, MeshRuntime, init_distributed, make_runtime
+from .sharding import (
+    DEFAULT_RULES,
+    opt_state_shardings,
+    params_shardings,
+    partition_spec,
+    shard_pytree,
+)
+from .step import TrainState, create_train_state, make_eval_step, make_train_step
+
+__all__ = [
+    "AXIS_NAMES",
+    "DEFAULT_RULES",
+    "MeshRuntime",
+    "TrainState",
+    "create_train_state",
+    "init_distributed",
+    "make_eval_step",
+    "make_runtime",
+    "make_train_step",
+    "opt_state_shardings",
+    "params_shardings",
+    "partition_spec",
+    "shard_pytree",
+]

@@ -1,0 +1,172 @@
+"""Device-mesh runtime — the TPU-native replacement for the reference's
+pluggable distributed-backend registry.
+
+The reference routes all distribution through a module-global
+``DistributedBackend`` singleton (distributed_utils.py:22-96) whose concrete
+engines (DeepSpeed -> NCCL, Horovod -> MPI ring) wrap the model, optimizer and
+dataloader imperatively (distributed_backends/*.py). On TPU none of that
+machinery survives: processes are started per host, ``jax.distributed``
+handles rendezvous, and parallelism is *declarative* — a
+``jax.sharding.Mesh`` plus sharding annotations on a jitted step, with XLA
+lowering the collectives onto ICI/DCN.
+
+``MeshRuntime`` is the explicit context object that replaces the hidden
+global (SURVEY.md §3.4): topology queries (world/rank/local-rank,
+distributed_backend.py:80-110), root-worker gating (:118-126), barriers
+(:128-138) and scalar metric averaging (:171-178) all live here, but
+``distribute()`` disappears — its job is done by the sharding specs in
+``parallel/sharding.py`` applied to a compiled train step.
+
+Axes:
+  dp    pure data parallelism (params replicated)
+  fsdp  data parallelism + parameter/optimizer sharding (ZeRO-equivalent;
+        the reference's config-gated DeepSpeed ZeRO, train_dalle.py:483-488)
+  tp    tensor parallelism over attention heads / FF hidden (beyond-parity)
+  sp    sequence/context parallelism (ring attention)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_NAMES = ("dp", "fsdp", "tp", "sp")
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host rendezvous (replaces deepspeed.init_distributed /
+    hvd.init(), deepspeed_backend.py:36-39, horovod_backend.py:20-23).
+
+    No-op for single-process runs; with explicit args or cluster env vars it
+    wires ``jax.distributed`` so ``jax.devices()`` spans all hosts.
+    """
+    if num_processes is None and coordinator_address is None:
+        return  # single process — nothing to rendezvous
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshRuntime:
+    """Explicit parallelism context: a named device mesh plus the topology
+    and collective helpers trainers need."""
+
+    mesh: Mesh
+
+    # ------------------------------------------------------------- topology
+
+    @property
+    def world_size(self) -> int:
+        """Total devices in the mesh (the reference's world = processes,
+        one per GPU; on TPU = chips)."""
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def process_count(self) -> int:
+        return jax.process_count()
+
+    @property
+    def local_device_count(self) -> int:
+        return jax.local_device_count()
+
+    def is_root_worker(self) -> bool:
+        """Global-root gating for logging/checkpoint writes
+        (distributed_backend.py:118-121)."""
+        return jax.process_index() == 0
+
+    def is_local_root_worker(self) -> bool:
+        """Per-host root, for host-local work like downloads
+        (distributed_backend.py:123-126, vae.py:67-74)."""
+        return True  # one process per host in JAX TPU deployments
+
+    # ----------------------------------------------------------- collectives
+
+    def barrier(self) -> None:
+        """Block until all processes arrive (local_barrier,
+        distributed_backend.py:128-138)."""
+        if jax.process_count() > 1:
+            # a tiny all-reduce across all devices acts as a barrier
+            x = jnp.ones((jax.local_device_count(),))
+            jax.block_until_ready(
+                jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x)
+            )
+
+    def average_all(self, value):
+        """Mean of a per-process scalar across the world — the reference's
+        ``average_all`` NCCL all-reduce for metric logging
+        (deepspeed_backend.py:165-171, horovod_backend.py:55-58).
+
+        Under a jitted sharded step this is unnecessary (reductions over
+        sharded arrays are already global); it exists for host-side metrics.
+        """
+        if jax.process_count() == 1:
+            return value
+        arr = jnp.asarray(value)[None].repeat(jax.local_device_count(), 0)
+        return float(
+            jax.pmap(lambda v: jax.lax.pmean(v, "i"), axis_name="i")(arr)[0]
+        )
+
+    # -------------------------------------------------------------- specs
+
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    @property
+    def data_spec(self) -> P:
+        """Batch axis sharded over every data-parallel axis."""
+        names = [n for n in ("dp", "fsdp") if self.mesh.shape.get(n, 1) > 1]
+        return P(tuple(names) if names else None)
+
+    @property
+    def data_sharding(self) -> NamedSharding:
+        return self.sharding(self.data_spec)
+
+    def check_batch_size(self, batch_size: int) -> None:
+        """Global batch must cover the data-parallel extent
+        (distributed_backend.py:56-60)."""
+        dp_total = self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1)
+        assert batch_size >= dp_total, (
+            f"batch size {batch_size} smaller than data-parallel extent {dp_total}"
+        )
+
+
+def make_runtime(
+    dp: Optional[int] = None,
+    fsdp: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> MeshRuntime:
+    """Build a MeshRuntime over the available devices.
+
+    ``dp=None`` absorbs whatever devices remain after fsdp/tp/sp are carved
+    out, so the default ``make_runtime()`` is pure data parallelism over all
+    chips.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    rest = fsdp * tp * sp
+    assert n % rest == 0, f"{n} devices not divisible by fsdp*tp*sp={rest}"
+    if dp is None:
+        dp = n // rest
+    assert dp * rest == n, (
+        f"mesh {dp}x{fsdp}x{tp}x{sp} != {n} available devices"
+    )
+    dev_array = np.asarray(devices).reshape(dp, fsdp, tp, sp)
+    return MeshRuntime(mesh=Mesh(dev_array, AXIS_NAMES))
