@@ -1,0 +1,33 @@
+from .loader import (
+    DataLoader,
+    ImageFolderDataset,
+    TextImageDataset,
+    image_to_array,
+    random_resized_crop,
+)
+from .tokenizers import (
+    ChineseTokenizer,
+    HugTokenizer,
+    SimpleTokenizer,
+    YttmTokenizer,
+    default_bpe_path,
+    get_tokenizer,
+)
+from .webdata import TarImageTextDataset, TarLoader, expand_urls
+
+__all__ = [
+    "ChineseTokenizer",
+    "DataLoader",
+    "HugTokenizer",
+    "ImageFolderDataset",
+    "SimpleTokenizer",
+    "TarImageTextDataset",
+    "TarLoader",
+    "TextImageDataset",
+    "YttmTokenizer",
+    "default_bpe_path",
+    "expand_urls",
+    "get_tokenizer",
+    "image_to_array",
+    "random_resized_crop",
+]

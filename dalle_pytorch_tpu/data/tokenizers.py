@@ -1,0 +1,298 @@
+"""Tokenizers, TPU-native data layer.
+
+Re-owns the reference's four interchangeable tokenizers (tokenizer.py:20-266)
+behind one duck-type: ``encode(text) -> [int]``, ``decode(ids, pad_tokens=...)
+-> str``, ``vocab_size``, and ``tokenize(texts, context_length, truncate_text)
+-> (b, context_length) int32 numpy array`` with the exact 0-pad / raise-unless-
+truncate contract (tokenizer.py:137-152). Outputs are host numpy — the device
+boundary is crossed once per batch by the loader, not per sample.
+
+``SimpleTokenizer`` is a from-scratch byte-level BPE (the CLIP scheme: byte ->
+unicode remap, end-of-word ``</w>`` marker, rank-greedy merge loop) over the
+standard ``bpe_simple_vocab_16e6.txt`` merges file (vocab 49408). The merges
+file is *data*, not code; it is resolved at runtime (env var, package data,
+cache, or an existing dalle-pytorch checkout) rather than vendored.
+
+ftfy is optional (reference hard-requires it, tokenizer.py:4): when absent,
+a NFC-normalization fallback keeps behavior sane on clean corpora.
+"""
+
+from __future__ import annotations
+
+import html
+import os
+import unicodedata
+from functools import lru_cache
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+try:
+    import ftfy
+
+    _HAS_FTFY = True
+except ImportError:
+    _HAS_FTFY = False
+
+import regex as re
+
+_BPE_FILENAME = "bpe_simple_vocab_16e6.txt"
+
+
+def default_bpe_path() -> Optional[str]:
+    """Locate the standard CLIP BPE merges file."""
+    candidates = [
+        os.environ.get("DALLE_TPU_BPE_PATH"),
+        str(Path(__file__).parent / _BPE_FILENAME),
+        str(Path.home() / ".cache" / "dalle_tpu" / _BPE_FILENAME),
+    ]
+    # an existing dalle-pytorch checkout/install also carries it
+    try:
+        import dalle_pytorch  # type: ignore
+
+        candidates.append(
+            str(Path(dalle_pytorch.__file__).parent / "data" / _BPE_FILENAME)
+        )
+    except ImportError:
+        pass
+    candidates.append(f"/root/reference/dalle_pytorch/data/{_BPE_FILENAME}")
+    for c in candidates:
+        if c and os.path.exists(c):
+            return c
+    return None
+
+
+@lru_cache()
+def bytes_to_unicode():
+    """Reversible byte -> printable-unicode map (the GPT-2/CLIP trick that
+    keeps BPE free of unk tokens while avoiding raw control characters)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+def basic_clean(text: str) -> str:
+    if _HAS_FTFY:
+        text = ftfy.fix_text(text)
+    else:
+        text = unicodedata.normalize("NFC", text)
+    text = html.unescape(html.unescape(text))
+    return text.strip()
+
+
+def whitespace_clean(text: str) -> str:
+    return re.sub(r"\s+", " ", text).strip()
+
+
+def _pairs(word: Sequence[str]):
+    return set(zip(word[:-1], word[1:]))
+
+
+class _TokenizeMixin:
+    """The shared tokenize() contract (reference tokenizer.py:137-152)."""
+
+    def tokenize(
+        self,
+        texts: Union[str, Iterable[str]],
+        context_length: int = 256,
+        truncate_text: bool = False,
+    ) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        all_tokens = [self.encode(t) for t in texts]
+        out = np.zeros((len(all_tokens), context_length), dtype=np.int32)
+        for i, tokens in enumerate(all_tokens):
+            if len(tokens) > context_length:
+                if truncate_text:
+                    tokens = tokens[:context_length]
+                else:
+                    raise RuntimeError(
+                        f"Input {texts[i]} is too long for context length "
+                        f"{context_length}"
+                    )
+            out[i, : len(tokens)] = tokens
+        return out
+
+
+class SimpleTokenizer(_TokenizeMixin):
+    """Byte-level BPE over the bundled 16e6 merges vocabulary (49408 tokens),
+    drop-in for the reference's SimpleTokenizer (tokenizer.py:20-154)."""
+
+    def __init__(self, bpe_path: Optional[str] = None):
+        bpe_path = bpe_path or default_bpe_path()
+        if bpe_path is None:
+            raise FileNotFoundError(
+                f"{_BPE_FILENAME} not found; set DALLE_TPU_BPE_PATH or place "
+                f"it in ~/.cache/dalle_tpu/"
+            )
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+
+        merges = Path(bpe_path).read_text(encoding="utf8").split("\n")
+        merges = merges[1 : 49152 - 256 - 2 + 1]
+        merges = [tuple(m.split()) for m in merges]
+
+        vocab = list(bytes_to_unicode().values())
+        vocab = vocab + [v + "</w>" for v in vocab]
+        for merge in merges:
+            vocab.append("".join(merge))
+        vocab.extend(["<|startoftext|>", "<|endoftext|>"])
+
+        self.encoder = dict(zip(vocab, range(len(vocab))))
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        self.bpe_ranks = dict(zip(merges, range(len(merges))))
+        self.cache = {
+            "<|startoftext|>": "<|startoftext|>",
+            "<|endoftext|>": "<|endoftext|>",
+        }
+        self.pat = re.compile(
+            r"<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d|"
+            r"[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+",
+            re.IGNORECASE,
+        )
+        self.vocab_size = len(self.encoder)  # 49408
+
+    def bpe(self, token: str) -> str:
+        if token in self.cache:
+            return self.cache[token]
+        word = tuple(token[:-1]) + (token[-1] + "</w>",)
+        pairs = _pairs(word)
+        if not pairs:
+            return token + "</w>"
+
+        while True:
+            bigram = min(pairs, key=lambda p: self.bpe_ranks.get(p, float("inf")))
+            if bigram not in self.bpe_ranks:
+                break
+            first, second = bigram
+            new_word: List[str] = []
+            i = 0
+            while i < len(word):
+                try:
+                    j = word.index(first, i)
+                except ValueError:
+                    new_word.extend(word[i:])
+                    break
+                new_word.extend(word[i:j])
+                i = j
+                if i < len(word) - 1 and word[i] == first and word[i + 1] == second:
+                    new_word.append(first + second)
+                    i += 2
+                else:
+                    new_word.append(word[i])
+                    i += 1
+            word = tuple(new_word)
+            if len(word) == 1:
+                break
+            pairs = _pairs(word)
+        result = " ".join(word)
+        self.cache[token] = result
+        return result
+
+    def encode(self, text: str) -> List[int]:
+        bpe_tokens: List[int] = []
+        text = whitespace_clean(basic_clean(text)).lower()
+        for token in re.findall(self.pat, text):
+            token = "".join(self.byte_encoder[b] for b in token.encode("utf-8"))
+            bpe_tokens.extend(self.encoder[t] for t in self.bpe(token).split(" "))
+        return bpe_tokens
+
+    def decode(self, tokens: Iterable[int], pad_tokens: set = frozenset()) -> str:
+        """ids -> text; ``pad_tokens`` (e.g. DALLE's per-position padding ids)
+        are dropped, as are 0s (the shared pad id)."""
+        text = "".join(
+            self.decoder[int(t)]
+            for t in tokens
+            if int(t) not in pad_tokens and int(t) != 0
+        )
+        return (
+            bytearray(self.byte_decoder[c] for c in text)
+            .decode("utf-8", errors="replace")
+            .replace("</w>", " ")
+        )
+
+
+class HugTokenizer(_TokenizeMixin):
+    """Custom byte-level BPE from a HuggingFace ``tokenizers`` json file
+    (reference tokenizer.py:158-192)."""
+
+    def __init__(self, bpe_path: str):
+        from tokenizers import Tokenizer  # Rust engine, baked in
+
+        assert Path(bpe_path).exists(), f"BPE json path {bpe_path} does not exist"
+        self.tokenizer = Tokenizer.from_file(str(bpe_path))
+        self.vocab_size = self.tokenizer.get_vocab_size()
+
+    def encode(self, text: str) -> List[int]:
+        return self.tokenizer.encode(text).ids
+
+    def decode(self, tokens: Iterable[int], pad_tokens: set = frozenset()) -> str:
+        ids = [int(t) for t in tokens if int(t) not in pad_tokens and int(t) != 0]
+        return self.tokenizer.decode(ids, skip_special_tokens=True)
+
+
+class ChineseTokenizer(_TokenizeMixin):
+    """BERT WordPiece for Chinese (reference tokenizer.py:196-228)."""
+
+    def __init__(self, model_name: str = "bert-base-chinese"):
+        from transformers import BertTokenizer
+
+        self.tokenizer = BertTokenizer.from_pretrained(model_name)
+        self.vocab_size = self.tokenizer.vocab_size
+
+    def encode(self, text: str) -> List[int]:
+        return self.tokenizer.encode(text, add_special_tokens=False)
+
+    def decode(self, tokens: Iterable[int], pad_tokens: set = frozenset()) -> str:
+        ids = [int(t) for t in tokens if int(t) not in pad_tokens and int(t) != 0]
+        return self.tokenizer.decode(ids)
+
+
+class YttmTokenizer(_TokenizeMixin):
+    """youtokentome BPE (reference tokenizer.py:232-266). The C++ yttm wheel
+    is not part of this image; the class gates on import so the API surface
+    stays complete."""
+
+    def __init__(self, bpe_path: str):
+        assert Path(bpe_path).exists(), f"BPE model path {bpe_path} does not exist"
+        try:
+            import youtokentome as yttm
+        except ImportError as e:
+            raise ImportError(
+                "YttmTokenizer requires the youtokentome package"
+            ) from e
+        self.tokenizer = yttm.BPE(model=str(bpe_path))
+        self.vocab_size = self.tokenizer.vocab_size()
+
+    def encode(self, text: str) -> List[int]:
+        import youtokentome as yttm
+
+        return self.tokenizer.encode([text], output_type=yttm.OutputType.ID)[0]
+
+    def decode(self, tokens: Iterable[int], pad_tokens: set = frozenset()) -> str:
+        return self.tokenizer.decode(
+            [[int(t) for t in tokens]], ignore_ids=list(pad_tokens) + [0]
+        )[0]
+
+
+_default: Optional[SimpleTokenizer] = None
+
+
+def get_tokenizer() -> SimpleTokenizer:
+    """Lazily-built module default (the reference builds one at import,
+    tokenizer.py:154; lazy keeps import cheap when the vocab is elsewhere)."""
+    global _default
+    if _default is None:
+        _default = SimpleTokenizer()
+    return _default

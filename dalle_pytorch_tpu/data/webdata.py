@@ -1,0 +1,223 @@
+"""Streaming tar-shard pipeline — the webdataset-equivalent.
+
+The reference builds its WebDataset pipeline inline in the trainer
+(train_dalle.py:200-216,353-374): brace-expanded ``.tar`` shard lists from
+disk, http or GCS (``pipe:curl``/``pipe:gsutil cat``), image/caption members
+paired by stem inside each tar, warn-and-continue error handling. This module
+re-owns that as a small stdlib implementation: sequential tar streaming
+(``r|*`` mode never seeks, so pipes work), per-host shard splitting, a
+shuffle buffer, and the same tokenize/crop mapping as the folder loader.
+"""
+
+from __future__ import annotations
+
+import io
+import random
+import re
+import shlex
+import subprocess
+import sys
+import tarfile
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+from PIL import Image
+
+from .loader import image_to_array, random_resized_crop
+
+IMAGE_KEYS = ("jpg", "jpeg", "png", "img", "image")
+CAPTION_KEYS = ("txt", "caption", "text")
+
+
+def expand_urls(spec: str) -> List[str]:
+    """Brace expansion: 'shard-{0000..0003}.tar' -> 4 urls (the webdataset
+    convention the reference relies on, train_dalle.py:200-216)."""
+    m = re.search(r"\{(\d+)\.\.(\d+)\}", spec)
+    if not m:
+        return [spec]
+    lo, hi = m.group(1), m.group(2)
+    width = len(lo)
+    out = []
+    for i in range(int(lo), int(hi) + 1):
+        out.extend(expand_urls(spec[: m.start()] + str(i).zfill(width) + spec[m.end() :]))
+    return out
+
+
+class _PipeStream:
+    """Wraps a pipe: subprocess stdout; close() reaps the child and surfaces
+    a nonzero exit so a dead curl isn't mistaken for a short shard."""
+
+    def __init__(self, cmd: str):
+        self._proc = subprocess.Popen(
+            shlex.split(cmd), stdout=subprocess.PIPE, stderr=subprocess.PIPE
+        )
+        self._cmd = cmd
+
+    def read(self, *a):
+        return self._proc.stdout.read(*a)
+
+    def close(self):
+        self._proc.stdout.close()
+        err = self._proc.stderr.read().decode(errors="replace")
+        self._proc.stderr.close()
+        code = self._proc.wait()
+        if code != 0:
+            print(
+                f"pipe command failed (exit {code}): {self._cmd}\n{err[-500:]}",
+                file=sys.stderr,
+            )
+
+
+def open_shard(url: str):
+    """A binary stream for one shard: local path, or 'pipe:<command>'
+    (curl/gsutil streaming, reference train_dalle.py:205-211)."""
+    if url.startswith("pipe:"):
+        return _PipeStream(url[len("pipe:") :])
+    return open(url, "rb")
+
+
+def iter_tar_samples(stream) -> Iterator[Dict[str, bytes]]:
+    """Group tar members by stem into {extension: bytes} sample dicts.
+    Members are assumed stem-contiguous (the webdataset layout)."""
+    current_stem: Optional[str] = None
+    sample: Dict[str, bytes] = {}
+    with tarfile.open(fileobj=stream, mode="r|*") as tf:
+        for member in tf:
+            if not member.isfile():
+                continue
+            name = Path(member.name)
+            stem, ext = str(name.parent / name.stem), name.suffix.lstrip(".").lower()
+            if stem != current_stem:
+                if sample:
+                    yield sample
+                current_stem, sample = stem, {}
+            f = tf.extractfile(member)
+            if f is not None:
+                sample[ext] = f.read()
+    if sample:
+        yield sample
+
+
+class TarImageTextDataset:
+    """Iterable (tokens, image) stream over tar shards.
+
+    Warn-and-continue on malformed samples (the reference's
+    wds.warn_and_continue, train_dalle.py:372).
+    """
+
+    def __init__(
+        self,
+        urls: str,
+        text_len: int = 256,
+        image_size: int = 128,
+        truncate_captions: bool = False,
+        resize_ratio: float = 0.75,
+        tokenizer=None,
+        image_key: Optional[str] = None,
+        caption_key: Optional[str] = None,
+        shuffle_buffer: int = 0,
+        process_index: int = 0,
+        process_count: int = 1,
+        seed: int = 0,
+    ):
+        self.urls = expand_urls(urls)
+        assert self.urls, f"no shards matched {urls}"
+        self.text_len = text_len
+        self.image_size = image_size
+        self.truncate_captions = truncate_captions
+        self.resize_ratio = resize_ratio
+        if tokenizer is None:
+            from .tokenizers import get_tokenizer
+
+            tokenizer = get_tokenizer()
+        self.tokenizer = tokenizer
+        self.image_keys = (image_key,) if image_key else IMAGE_KEYS
+        self.caption_keys = (caption_key,) if caption_key else CAPTION_KEYS
+        self.shuffle_buffer = shuffle_buffer
+        self.process_index = process_index
+        self.process_count = process_count
+        self._rng = random.Random(seed + process_index)
+
+    def _my_shards(self) -> List[str]:
+        # wrap-pad so no host ends up with zero shards (sample counts can
+        # still differ per shard — tar streams carry no epoch barrier)
+        urls = list(self.urls)
+        if len(urls) % self.process_count:
+            urls = urls + urls[: self.process_count - len(urls) % self.process_count]
+        return urls[self.process_index :: self.process_count]
+
+    def _map(self, sample: Dict[str, bytes]) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        img_bytes = next(
+            (sample[k] for k in self.image_keys if k in sample), None
+        )
+        cap_bytes = next(
+            (sample[k] for k in self.caption_keys if k in sample), None
+        )
+        if img_bytes is None or cap_bytes is None:
+            return None
+        try:
+            caption = cap_bytes.decode("utf-8")
+            tokens = self.tokenizer.tokenize(
+                caption, self.text_len, truncate_text=self.truncate_captions
+            )[0]
+            with Image.open(io.BytesIO(img_bytes)) as img:
+                img = random_resized_crop(
+                    img, self.image_size, self._rng, self.resize_ratio
+                )
+                image = image_to_array(img)
+        except Exception as e:  # warn-and-continue
+            print(f"tar sample skipped: {type(e).__name__}: {e}", file=sys.stderr)
+            return None
+        return tokens, image
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        buf: List[Tuple[np.ndarray, np.ndarray]] = []
+        shards = list(self._my_shards())
+        if self.shuffle_buffer:
+            self._rng.shuffle(shards)
+        for url in shards:
+            try:
+                stream = open_shard(url)
+            except OSError as e:
+                print(f"shard {url} skipped: {e}", file=sys.stderr)
+                continue
+            try:
+                for raw in iter_tar_samples(stream):
+                    mapped = self._map(raw)
+                    if mapped is None:
+                        continue
+                    if self.shuffle_buffer:
+                        buf.append(mapped)
+                        if len(buf) >= self.shuffle_buffer:
+                            i = self._rng.randrange(len(buf))
+                            buf[i], buf[-1] = buf[-1], buf[i]
+                            yield buf.pop()
+                    else:
+                        yield mapped
+            except tarfile.TarError as e:
+                print(f"shard {url} aborted: {e}", file=sys.stderr)
+            finally:
+                stream.close()
+        self._rng.shuffle(buf)
+        yield from buf
+
+
+class TarLoader:
+    """Batch iterator over a TarImageTextDataset (the reference's WebLoader
+    role, train_dalle.py:400-405)."""
+
+    def __init__(self, dataset: TarImageTextDataset, batch_size: int):
+        self.dataset = dataset
+        self.batch_size = batch_size
+
+    def __iter__(self) -> Iterator[dict]:
+        batch: List[Tuple[np.ndarray, np.ndarray]] = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield {
+                    "text": np.stack([b[0] for b in batch]).astype(np.int32),
+                    "image": np.stack([b[1] for b in batch]),
+                }
+                batch = []

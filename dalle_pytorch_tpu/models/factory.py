@@ -1,0 +1,148 @@
+"""Model reconstitution from checkpoints.
+
+The reference snapshots model hparams inside every checkpoint so generation
+needs no flag re-specification (train_dalle.py:514-517, generate.py:81-95).
+Same contract here: the plain checkpoint carries ``meta`` with the model-class
+name and constructor kwargs plus (for DALLE) the VAE class/params, and these
+helpers rebuild modules + params from a path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..utils.checkpoint import load_checkpoint, save_checkpoint
+from .dalle import DALLE
+from .vae import DiscreteVAE
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+def _config_dict(module) -> dict:
+    """Constructor kwargs of a flax module (dataclass fields), with dtypes
+    stringified for json."""
+    out = {}
+    for f in dataclasses.fields(module):
+        if f.name in ("parent", "name"):
+            continue
+        v = getattr(module, f.name)
+        if v in (jnp.float32, jnp.bfloat16, jnp.float16):
+            v = jnp.dtype(v).name
+        out[f.name] = v
+    return out
+
+
+def _restore_dtypes(cfg: dict) -> dict:
+    cfg = dict(cfg)
+    for k in ("dtype", "param_dtype"):
+        if isinstance(cfg.get(k), str):
+            cfg[k] = _DTYPES[cfg[k]]
+    if "attn_types" in cfg and isinstance(cfg["attn_types"], list):
+        cfg["attn_types"] = tuple(cfg["attn_types"])
+    if "normalization" in cfg and isinstance(cfg["normalization"], list):
+        cfg["normalization"] = tuple(tuple(x) for x in cfg["normalization"])
+    if "shape" in cfg and isinstance(cfg["shape"], list):
+        cfg["shape"] = tuple(cfg["shape"])
+    return cfg
+
+
+# ------------------------------------------------------------------- VAE
+
+
+def save_vae_checkpoint(path: str, vae: DiscreteVAE, params: Any, extra: Optional[dict] = None):
+    meta = {"model_class": "DiscreteVAE", "config": _config_dict(vae), **(extra or {})}
+    save_checkpoint(path, {"params": params}, meta)
+
+
+def vae_from_checkpoint(path: str) -> Tuple[DiscreteVAE, Any, dict]:
+    state, meta = load_checkpoint(path)
+    assert meta.get("model_class") == "DiscreteVAE", (
+        f"not a DiscreteVAE checkpoint: {meta.get('model_class')}"
+    )
+    vae = DiscreteVAE(**_restore_dtypes(meta["config"]))
+    params = vae.init(
+        {"params": __import__("jax").random.key(0), "gumbel": __import__("jax").random.key(0)},
+        jnp.zeros((1, vae.image_size, vae.image_size, vae.channels)),
+    )["params"]
+    from flax import serialization
+
+    params = serialization.from_state_dict(params, state["params"])
+    return vae, params, meta
+
+
+# ------------------------------------------------------------------ DALLE
+
+
+def save_dalle_checkpoint(
+    path: str,
+    dalle: DALLE,
+    params: Any,
+    vae: Optional[DiscreteVAE] = None,
+    vae_params: Any = None,
+    extra: Optional[dict] = None,
+    opt_state: Any = None,
+    step: Any = None,
+):
+    """Plain single-file DALLE checkpoint bundling the frozen VAE and (when
+    given) the optimizer state — the reference's {hparams, vae_params, epoch,
+    weights, opt_state, scheduler_state} layout (train_dalle.py:514-519)."""
+    meta = {
+        "model_class": "DALLE",
+        "config": _config_dict(dalle),
+        **(extra or {}),
+    }
+    state = {"params": params}
+    if vae is not None:
+        meta["vae_class"] = type(vae).__name__
+        meta["vae_config"] = _config_dict(vae)
+        state["vae_params"] = vae_params
+    if opt_state is not None:
+        state["opt_state"] = opt_state
+        meta["has_opt_state"] = True
+    if step is not None:
+        state["step"] = step
+    save_checkpoint(path, state, meta)
+
+
+def restore_opt_state(path: str, target: Any) -> Optional[Any]:
+    """Restore the optimizer state saved by ``save_dalle_checkpoint`` into
+    ``target``'s structure (None when the checkpoint carries none), so resume
+    keeps Adam moments instead of silently resetting them."""
+    from flax import serialization
+
+    state, meta = load_checkpoint(path)
+    if not meta.get("has_opt_state"):
+        return None
+    return serialization.from_state_dict(target, state["opt_state"])
+
+
+def dalle_from_checkpoint(path: str):
+    """-> (dalle, params, vae, vae_params, meta); vae is None when the
+    checkpoint carries no VAE."""
+    import jax
+    from flax import serialization
+
+    state, meta = load_checkpoint(path)
+    assert meta.get("model_class") == "DALLE", (
+        f"not a DALLE checkpoint: {meta.get('model_class')}"
+    )
+    dalle = DALLE(**_restore_dtypes(meta["config"]))
+    text = jnp.zeros((1, dalle.text_seq_len), jnp.int32)
+    image = jnp.zeros((1, dalle.image_seq_len), jnp.int32)
+    params = jax.eval_shape(lambda: dalle.init(jax.random.key(0), text, image))["params"]
+    params = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), params)
+    params = serialization.from_state_dict(params, state["params"])
+
+    vae = vae_params = None
+    if "vae_config" in meta:
+        assert meta.get("vae_class") == "DiscreteVAE", meta.get("vae_class")
+        vae = DiscreteVAE(**_restore_dtypes(meta["vae_config"]))
+        vp = vae.init(
+            {"params": jax.random.key(0), "gumbel": jax.random.key(0)},
+            jnp.zeros((1, vae.image_size, vae.image_size, vae.channels)),
+        )["params"]
+        vae_params = serialization.from_state_dict(vp, state["vae_params"])
+    return dalle, params, vae, vae_params, meta

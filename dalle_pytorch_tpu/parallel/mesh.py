@@ -122,6 +122,16 @@ class MeshRuntime:
             jax.pmap(lambda v: jax.lax.pmean(v, "i"), axis_name="i")(arr)[0]
         )
 
+    def to_host(self, tree):
+        """Gather a (possibly multi-host-sharded) pytree to host numpy on
+        every process. All processes must call this (it is a collective when
+        process_count > 1); file writes afterwards belong on the root only."""
+        if jax.process_count() == 1:
+            return jax.tree_util.tree_map(np.asarray, tree)
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(tree)
+
     # -------------------------------------------------------------- specs
 
     def sharding(self, spec: P) -> NamedSharding:

@@ -67,12 +67,19 @@ def make_train_step(
     state_shardings: TrainState,
     has_aux: bool = False,
     donate: bool = True,
+    dynamic_lr: bool = False,
+    data_shardings: Any = None,
 ):
-    """Compile ``(state, batch, rng) -> (state, loss[, aux])``.
+    """Compile ``(state, batch, rng[, lr]) -> (state, loss[, aux])``.
 
     ``loss_fn(params, batch, rng)`` must be pure; reductions over the sharded
     batch are global under jit, so the reference's explicit ``average_all``
     loss collective (train_dalle.py:587) is implicit here.
+
+    ``dynamic_lr=True`` adds a traced learning-rate argument and applies
+    ``-lr`` scaling in the step — the optimizer chain must then end at
+    unscaled update directions (e.g. ``scale_by_adam`` without ``scale``), so
+    host-side schedulers (ReduceLROnPlateau) change lr without recompiling.
     """
     replicated = NamedSharding(runtime.mesh, P())
 
@@ -81,18 +88,25 @@ def make_train_step(
         if has_aux
         else (state_shardings, replicated)
     )
+    if data_shardings is None:
+        data_shardings = runtime.data_sharding  # batch-dim sharding, all leaves
+    in_shardings = [state_shardings, data_shardings, replicated]
+    if dynamic_lr:
+        in_shardings.append(replicated)
 
     @partial(
         jax.jit,
-        in_shardings=(state_shardings, runtime.data_sharding, replicated),
+        in_shardings=tuple(in_shardings),
         out_shardings=out_shardings,
         donate_argnums=(0,) if donate else (),
     )
-    def train_step(state: TrainState, batch, rng):
+    def train_step(state: TrainState, batch, rng, lr=None):
         grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
         out, grads = grad_fn(state.params, batch, rng)
         loss, aux = out if has_aux else (out, None)
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        if dynamic_lr:
+            updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
         params = optax.apply_updates(state.params, updates)
         new_state = TrainState(step=state.step + 1, params=params, opt_state=opt_state)
         if has_aux:

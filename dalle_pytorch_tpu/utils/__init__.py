@@ -1,0 +1,30 @@
+from .checkpoint import (
+    load_checkpoint,
+    load_sharded_checkpoint,
+    save_checkpoint,
+    save_sharded_checkpoint,
+)
+from .download import CACHE_DIR, download
+from .metrics import MetricsLogger, Throughput, mfu
+from .schedules import (
+    ConstantLR,
+    ExponentialDecay,
+    ReduceLROnPlateau,
+    gumbel_temperature,
+)
+
+__all__ = [
+    "CACHE_DIR",
+    "ConstantLR",
+    "ExponentialDecay",
+    "MetricsLogger",
+    "ReduceLROnPlateau",
+    "Throughput",
+    "download",
+    "gumbel_temperature",
+    "load_checkpoint",
+    "load_sharded_checkpoint",
+    "mfu",
+    "save_checkpoint",
+    "save_sharded_checkpoint",
+]

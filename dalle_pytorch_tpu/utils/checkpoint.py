@@ -1,0 +1,121 @@
+"""Checkpoint store — dual-format, mirroring the reference's semantics
+(SURVEY.md §5.4).
+
+Plain format (reference train_dalle.py:514-519 ``torch.save`` of
+``{hparams, vae_params, epoch, weights, opt_state, scheduler_state}``):
+one msgpack file holding json-encoded hparams plus the numpy-ified state
+pytree — readable on any host, no framework pickle.
+
+Sharded format (reference DeepSpeed ``save_checkpoint`` into a ``-ds-cp/``
+dir, train_dalle.py:520-544): an orbax directory checkpoint that writes each
+host's addressable shards in parallel — the right format for fsdp/tp-sharded
+TrainStates — plus the same ``aux.json`` hparams sidecar the reference keeps
+in ``auxiliary.pt``. Rotation keeps the newest N step dirs
+(cp_files_to_keep, train_dalle.py:523-526).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+_HEADER_KEY = "__dalle_tpu_meta__"
+
+
+def _to_host(tree: Any) -> Any:
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+
+
+def save_checkpoint(path: str, state: Any, meta: Optional[dict] = None) -> None:
+    """Plain single-file save: msgpack of {meta-json, state} with every leaf
+    a host numpy array (gathers sharded arrays — use the sharded format for
+    models that don't fit one host)."""
+    payload = {
+        _HEADER_KEY: json.dumps(meta or {}),
+        "state": serialization.to_state_dict(_to_host(state)),
+    }
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_suffix(p.suffix + ".tmp")
+    tmp.write_bytes(serialization.msgpack_serialize(payload))
+    tmp.replace(p)  # atomic: never leave a torn checkpoint
+
+
+def load_checkpoint(path: str, target: Any = None) -> tuple[Any, dict]:
+    """-> (state, meta). With ``target`` (a template pytree) the state is
+    restored into that structure; otherwise a raw nested dict is returned."""
+    raw = serialization.msgpack_restore(Path(path).read_bytes())
+    meta = json.loads(raw.pop(_HEADER_KEY, "{}"))
+    state = raw["state"]
+    if target is not None:
+        state = serialization.from_state_dict(target, state)
+    return state, meta
+
+
+# ----------------------------------------------------------- sharded format
+
+
+def save_sharded_checkpoint(
+    ckpt_dir: str,
+    step: int,
+    state: Any,
+    meta: Optional[dict] = None,
+    keep_n: Optional[int] = None,
+) -> str:
+    """Write ``<ckpt_dir>/step_<n>/`` via orbax (each host writes its own
+    shards) plus an ``aux.json`` hparams sidecar; rotate old step dirs."""
+    import orbax.checkpoint as ocp
+
+    root = Path(ckpt_dir)
+    root.mkdir(parents=True, exist_ok=True)
+    target = (root / f"step_{step:08d}").resolve()
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(target, state, force=True)
+    (root / "aux.json").write_text(json.dumps({"meta": meta or {}, "latest": step}))
+
+    if keep_n is not None:
+        steps = sorted(root.glob("step_*"))
+        for old in steps[:-keep_n]:
+            shutil.rmtree(old, ignore_errors=True)
+    return str(target)
+
+
+def load_sharded_checkpoint(
+    ckpt_dir: str,
+    target: Any,
+    step: Optional[int] = None,
+    shardings: Any = None,
+) -> tuple[Any, dict, int]:
+    """Restore the newest (or given) step dir into ``target``'s structure,
+    placing leaves with ``shardings`` when given. -> (state, meta, step)."""
+    import orbax.checkpoint as ocp
+
+    root = Path(ckpt_dir)
+    aux = json.loads((root / "aux.json").read_text()) if (root / "aux.json").exists() else {}
+    if step is None:
+        steps = sorted(root.glob("step_*"))
+        assert steps, f"no step_* checkpoints under {ckpt_dir}"
+        path = steps[-1].resolve()
+        step = int(path.name.split("_")[1])
+    else:
+        path = (root / f"step_{step:08d}").resolve()
+
+    if shardings is not None:
+        abstract = jax.tree_util.tree_map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            target,
+            shardings,
+        )
+        args = __import__("orbax.checkpoint", fromlist=["args"]).args
+        with ocp.PyTreeCheckpointer() as ckptr:
+            state = ckptr.restore(path, args=args.PyTreeRestore(item=abstract))
+    else:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            state = ckptr.restore(path, item=target)
+    return state, aux.get("meta", {}), step

@@ -1,0 +1,50 @@
+"""Rank-aware download/cache utility.
+
+Re-owns the reference's ``download`` (vae.py:53-94): files land in a local
+cache directory; only the per-host root process downloads while other local
+ranks wait at a barrier, preventing N processes from fetching the same
+checkpoint. On TPU pods JAX runs one process per host, so the local-root race
+is rare — the coordination hook stays for multi-process-per-host setups.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import urllib.request
+from pathlib import Path
+from typing import Optional
+
+CACHE_DIR = os.path.expanduser("~/.cache/dalle_tpu")
+
+
+def download(
+    url: str,
+    filename: Optional[str] = None,
+    root: str = CACHE_DIR,
+    runtime=None,
+) -> str:
+    """Fetch ``url`` into ``root`` (once per host) and return the local path.
+
+    ``runtime`` (a MeshRuntime) gates the fetch to the local root worker and
+    barriers the rest — the reference's local_barrier dance (vae.py:67-74).
+    """
+    filename = filename or url.split("/")[-1]
+    path = Path(root) / filename
+    if path.exists():
+        return str(path)
+
+    is_local_root = runtime is None or runtime.is_local_root_worker()
+    if is_local_root:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        if url.startswith(("http://", "https://")):
+            with urllib.request.urlopen(url) as r, open(tmp, "wb") as f:
+                shutil.copyfileobj(r, f)
+        else:  # local/NFS path "url"s work too (common on pods)
+            shutil.copyfile(url, tmp)
+        tmp.replace(path)
+    if runtime is not None:
+        runtime.barrier()  # non-roots wait for the file to appear
+    assert path.exists(), f"download of {url} failed"
+    return str(path)

@@ -1,0 +1,105 @@
+"""Metrics sink: console + optional Weights & Biases, root-rank-guarded.
+
+Mirrors the reference's observability surface (SURVEY.md §5.5): per-step
+loss/lr logs (train_dalle.py:589-599), throughput as ``sample_per_sec``
+computed over 10-step windows (train_dalle.py:568-569,621-624), periodic
+sample images, and run config capture — with wandb optional (gated import)
+instead of required, and an MFU gauge the reference lacks.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Optional
+
+
+class MetricsLogger:
+    def __init__(
+        self,
+        project: Optional[str] = None,
+        run_name: Optional[str] = None,
+        config: Optional[dict] = None,
+        enabled: bool = True,
+        use_wandb: bool = False,
+        log_file: Optional[str] = None,
+    ):
+        self.enabled = enabled
+        self._wandb = None
+        self._file = None
+        if not enabled:
+            return
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb
+                wandb.init(project=project or "dalle_tpu", name=run_name, config=config)
+            except ImportError:
+                print("wandb not installed; falling back to console logs", file=sys.stderr)
+        if log_file:
+            self._file = open(log_file, "a")
+        if config:
+            self.log_text(f"config: {json.dumps(config, default=str)}")
+
+    def log(self, metrics: dict, step: Optional[int] = None) -> None:
+        if not self.enabled:
+            return
+        if self._wandb is not None:
+            self._wandb.log(metrics, step=step)
+        line = " ".join(
+            f"{k}={v:.5g}" if isinstance(v, float) else f"{k}={v}"
+            for k, v in metrics.items()
+        )
+        prefix = f"step {step}: " if step is not None else ""
+        print(prefix + line, flush=True)
+        if self._file:
+            self._file.write(json.dumps({"step": step, **metrics}, default=str) + "\n")
+            self._file.flush()
+
+    def log_text(self, text: str) -> None:
+        if self.enabled:
+            print(text, flush=True)
+
+    def log_images(self, name: str, images, step: Optional[int] = None, captions=None):
+        """images: (b, h, w, 3) float in [0,1]; saved to wandb when active."""
+        if not self.enabled or self._wandb is None:
+            return
+        imgs = [
+            self._wandb.Image(
+                (im * 255).clip(0, 255).astype("uint8"),
+                caption=None if captions is None else captions[i],
+            )
+            for i, im in enumerate(images)
+        ]
+        self._wandb.log({name: imgs}, step=step)
+
+    def finish(self):
+        if self._wandb is not None:
+            self._wandb.finish()
+        if self._file:
+            self._file.close()
+
+
+class Throughput:
+    """sample_per_sec over an N-step window (train_dalle.py:621-624)."""
+
+    def __init__(self, window: int = 10):
+        self.window = window
+        self._t0 = time.perf_counter()
+        self._count = 0
+
+    def update(self, samples: int) -> Optional[float]:
+        """Add one step's samples; returns samples/sec once per window."""
+        self._count += samples
+        if self._count and self._count % (samples * self.window) == 0:
+            now = time.perf_counter()
+            rate = samples * self.window / (now - self._t0)
+            self._t0 = now
+            return rate
+        return None
+
+
+def mfu(flops_per_step: float, step_time_s: float, peak_flops: float) -> float:
+    return flops_per_step / step_time_s / peak_flops

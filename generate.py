@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Inference CLI: text -> images from a trained DALL-E checkpoint.
+
+Mirrors the reference ``generate.py`` surface: checkpoint carries all hparams
+(no model flags needed), prompts split on '|', batched generation, numbered
+outputs per prompt under --outputs_dir, optional text completion (--gentxt).
+Sampling runs the KV-cached scan decoder (one compile, O(seq) per token)
+instead of the reference's full re-forward per token
+(dalle_pytorch.py:481-486).
+"""
+
+import argparse
+from pathlib import Path
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="Generate images from a DALL-E checkpoint")
+    parser.add_argument("--dalle_path", type=str, required=True)
+    parser.add_argument("--text", type=str, required=True,
+                        help="prompt(s); multiple prompts split on |")
+    parser.add_argument("--num_images", type=int, default=128)
+    parser.add_argument("--batch_size", type=int, default=4)
+    parser.add_argument("--top_k", type=float, default=0.9,
+                        help="fractional top-k filter threshold (reference top_k thres)")
+    parser.add_argument("--temperature", type=float, default=1.0)
+    parser.add_argument("--outputs_dir", type=str, default="./outputs")
+    parser.add_argument("--bpe_path", type=str, default=None)
+    parser.add_argument("--hug", action="store_true")
+    parser.add_argument("--chinese", action="store_true")
+    parser.add_argument("--gentxt", action="store_true",
+                        help="complete the prompt with the model before generating images")
+    parser.add_argument("--seed", type=int, default=0)
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from PIL import Image
+
+    from dalle_pytorch_tpu.data import ChineseTokenizer, HugTokenizer, SimpleTokenizer
+    from dalle_pytorch_tpu.models import generate_image_tokens, generate_texts
+    from dalle_pytorch_tpu.models.factory import dalle_from_checkpoint
+    from dalle_pytorch_tpu.models.vae import DiscreteVAE
+
+    assert Path(args.dalle_path).exists(), f"checkpoint not found at {args.dalle_path}"
+    dalle, params, vae, vae_params, meta = dalle_from_checkpoint(args.dalle_path)
+    assert vae is not None, "checkpoint carries no VAE — cannot decode images"
+
+    if args.chinese:
+        tokenizer = ChineseTokenizer()
+    elif args.hug:
+        tokenizer = HugTokenizer(args.bpe_path)
+    else:
+        tokenizer = SimpleTokenizer(args.bpe_path)
+
+    texts = [t.strip() for t in args.text.split("|") if t.strip()]
+    outputs_dir = Path(args.outputs_dir)
+
+    key = jax.random.key(args.seed)
+    decode = jax.jit(
+        lambda seq: vae.apply({"params": vae_params}, seq, method=DiscreteVAE.decode)
+    )
+
+    for text in texts:
+        if args.gentxt:
+            prompt_ids = jnp.asarray([tokenizer.encode(text)], jnp.int32)
+            key, sub = jax.random.split(key)
+            _, completed = generate_texts(
+                dalle, params, sub, prompt_ids, tokenizer=tokenizer,
+                filter_thres=args.top_k, temperature=args.temperature,
+            )
+            text = completed[0].strip() if completed else text
+            print(f"completed prompt: {text}")
+
+        tokens = tokenizer.tokenize(
+            [text], dalle.text_seq_len, truncate_text=True
+        ).repeat(args.batch_size, axis=0)
+        tokens = jnp.asarray(tokens)
+
+        images = []
+        for _ in range(-(-args.num_images // args.batch_size)):
+            key, sub = jax.random.split(key)
+            img_seq = generate_image_tokens(
+                dalle, params, tokens, sub,
+                filter_thres=args.top_k, temperature=args.temperature,
+            )
+            images.append(np.asarray(decode(img_seq)))
+        images = np.concatenate(images)[: args.num_images]
+
+        sub_dir = outputs_dir / text.replace(" ", "_")[:100]
+        sub_dir.mkdir(parents=True, exist_ok=True)
+        for i, arr in enumerate(images):
+            Image.fromarray((arr.clip(0, 1) * 255).astype(np.uint8)).save(
+                sub_dir / f"{i}.png"
+            )
+        (sub_dir / "caption.txt").write_text(text)
+        print(f"created {len(images)} images at '{sub_dir}'")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,363 @@
+#!/usr/bin/env python
+"""DALL-E training CLI, TPU-native.
+
+Mirrors the reference ``train_dalle.py`` app surface (SURVEY.md §2.1): VAE
+reconstitution, folder or tar-shard datasets, resume, clip-grad Adam with
+optional ReduceLROnPlateau, periodic checkpoint/sample/metric emission, and a
+pre-flight checkpoint save that fails fast on misconfiguration
+(train_dalle.py:561-563) — around one compiled sharded train step.
+
+Differences from the reference, by design:
+- VAE encode (frozen, no-grad) runs as its own jitted call feeding image
+  tokens to the train step (the reference calls it under no_grad inside
+  forward, dalle_pytorch.py:533-540);
+- --fp16/--amp map to bf16 (no loss scaling needed on TPU);
+- DeepSpeed/Horovod backend flags become mesh axis flags (--fsdp/--tp).
+"""
+
+import argparse
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="Train DALL-E on TPU")
+    group = parser.add_mutually_exclusive_group(required=False)
+    group.add_argument("--vae_path", type=str, help="path to a trained DiscreteVAE checkpoint")
+    group.add_argument("--dalle_path", type=str, help="path to a partially trained DALL-E to resume")
+    parser.add_argument("--image_text_folder", type=str, required=True,
+                        help="folder of images + same-stem .txt captions, or a .tar shard spec")
+    parser.add_argument("--wds", action="store_true",
+                        help="treat image_text_folder as a webdataset tar shard spec")
+    parser.add_argument("--truncate_captions", action="store_true")
+    parser.add_argument("--random_resize_crop_lower_ratio", dest="resize_ratio",
+                        type=float, default=0.75)
+    parser.add_argument("--chinese", action="store_true")
+    parser.add_argument("--hug", action="store_true")
+    parser.add_argument("--bpe_path", type=str, default=None)
+    parser.add_argument("--dalle_output_file_name", type=str, default="dalle")
+    parser.add_argument("--fp16", "--bf16", dest="bf16", action="store_true",
+                        help="bf16 compute (the TPU-native analog of --fp16/--amp)")
+    parser.add_argument("--amp", dest="bf16", action="store_true")
+    parser.add_argument("--wandb", action="store_true")
+    parser.add_argument("--wandb_name", default="dalle_train_transformer")
+    parser.add_argument("--stable_softmax", action="store_true")
+    parser.add_argument("--seed", type=int, default=42)
+
+    mesh_group = parser.add_argument_group("Mesh settings")
+    mesh_group.add_argument("--fsdp", type=int, default=1)
+    mesh_group.add_argument("--tp", type=int, default=1)
+
+    train_group = parser.add_argument_group("Training settings")
+    train_group.add_argument("--epochs", default=20, type=int)
+    train_group.add_argument("--save_every_n_steps", default=1000, type=int)
+    train_group.add_argument("--sample_every_n_steps", default=1000, type=int)
+    train_group.add_argument("--keep_n_checkpoints", default=None, type=int)
+    train_group.add_argument("--batch_size", default=4, type=int)
+    train_group.add_argument("--ga_steps", default=1, type=int,
+                             help="gradient accumulation steps")
+    train_group.add_argument("--learning_rate", default=3e-4, type=float)
+    train_group.add_argument("--clip_grad_norm", default=0.5, type=float)
+    train_group.add_argument("--lr_decay", action="store_true")
+    train_group.add_argument("--sharded_ckpt", action="store_true",
+                             help="also write orbax sharded checkpoints (multi-host scale)")
+
+    model_group = parser.add_argument_group("Model settings")
+    model_group.add_argument("--dim", default=512, type=int)
+    model_group.add_argument("--text_seq_len", default=256, type=int)
+    model_group.add_argument("--depth", default=2, type=int)
+    model_group.add_argument("--heads", default=8, type=int)
+    model_group.add_argument("--dim_head", default=64, type=int)
+    model_group.add_argument("--ff_dropout", default=0.0, type=float)
+    model_group.add_argument("--attn_dropout", default=0.0, type=float)
+    model_group.add_argument("--reversible", action="store_true")
+    model_group.add_argument("--remat", action="store_true",
+                             help="jax.checkpoint rematerialization per block")
+    model_group.add_argument("--loss_img_weight", default=7, type=int)
+    model_group.add_argument("--attn_types", default="full", type=str,
+                             help="comma-separated: full, sparse, axial_row, axial_col, conv_like, mlp")
+    model_group.add_argument("--shift_tokens", action="store_true")
+    model_group.add_argument("--rotary_emb", action="store_true")
+    return parser.parse_args()
+
+
+def pick_tokenizer(args):
+    from dalle_pytorch_tpu.data import (
+        ChineseTokenizer,
+        HugTokenizer,
+        SimpleTokenizer,
+        YttmTokenizer,
+    )
+
+    if args.chinese:
+        return ChineseTokenizer()
+    if args.hug:
+        assert args.bpe_path is not None, "--hug requires --bpe_path (tokenizer json)"
+        return HugTokenizer(args.bpe_path)
+    if args.bpe_path is not None:
+        if args.bpe_path.endswith(".json"):
+            return HugTokenizer(args.bpe_path)
+        if args.bpe_path.endswith(".model"):
+            return YttmTokenizer(args.bpe_path)
+    return SimpleTokenizer(args.bpe_path)
+
+
+def main():
+    args = parse_args()
+
+    from dalle_pytorch_tpu.data import DataLoader, TarImageTextDataset, TarLoader, TextImageDataset
+    from dalle_pytorch_tpu.models import DALLE, DiscreteVAE, generate_images
+    from dalle_pytorch_tpu.models.factory import (
+        dalle_from_checkpoint,
+        save_dalle_checkpoint,
+        vae_from_checkpoint,
+    )
+    from dalle_pytorch_tpu.parallel import (
+        create_train_state,
+        init_distributed,
+        make_runtime,
+        make_train_step,
+    )
+    from dalle_pytorch_tpu.utils import (
+        MetricsLogger,
+        ReduceLROnPlateau,
+        ConstantLR,
+        Throughput,
+        save_sharded_checkpoint,
+    )
+
+    init_distributed()
+    runtime = make_runtime(fsdp=args.fsdp, tp=args.tp)
+    runtime.check_batch_size(args.batch_size)
+    tokenizer = pick_tokenizer(args)
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+
+    # ---- VAE + DALLE reconstitution (resume | vae_path | error) ----------
+    start_epoch = 0
+    sched_state = None
+    resume_params = None
+    if args.dalle_path:
+        dalle, resume_params, vae, vae_params, meta = dalle_from_checkpoint(args.dalle_path)
+        start_epoch = int(meta.get("epoch", -1)) + 1
+        sched_state = meta.get("scheduler_state")
+        assert vae is not None, "resume checkpoint carries no VAE"
+    else:
+        assert args.vae_path, (
+            "--vae_path (trained DiscreteVAE checkpoint) or --dalle_path is "
+            "required; pretrained OpenAI/VQGAN wrappers land via "
+            "dalle_pytorch_tpu.models.pretrained"
+        )
+        vae, vae_params, _ = vae_from_checkpoint(args.vae_path)
+        dalle = DALLE(
+            dim=args.dim,
+            depth=args.depth,
+            num_text_tokens=tokenizer.vocab_size,
+            text_seq_len=args.text_seq_len,
+            num_image_tokens=vae.num_tokens,
+            image_fmap_size=vae.fmap_size,
+            heads=args.heads,
+            dim_head=args.dim_head,
+            reversible=args.reversible,
+            attn_dropout=args.attn_dropout,
+            ff_dropout=args.ff_dropout,
+            attn_types=tuple(args.attn_types.split(",")),
+            loss_img_weight=args.loss_img_weight,
+            stable=args.stable_softmax,
+            shift_tokens=args.shift_tokens,
+            rotary_emb=args.rotary_emb,
+            remat=args.remat,
+            dtype=dtype,
+        )
+
+    # ---- data ------------------------------------------------------------
+    if args.wds or args.image_text_folder.endswith(".tar"):
+        dataset = TarImageTextDataset(
+            args.image_text_folder,
+            text_len=dalle.text_seq_len,
+            image_size=vae.image_size,
+            truncate_captions=args.truncate_captions,
+            resize_ratio=args.resize_ratio,
+            tokenizer=tokenizer,
+            process_index=runtime.process_index,
+            process_count=runtime.process_count,
+        )
+        loader = TarLoader(dataset, args.batch_size)
+    else:
+        dataset = TextImageDataset(
+            args.image_text_folder,
+            text_len=dalle.text_seq_len,
+            image_size=vae.image_size,
+            truncate_captions=args.truncate_captions,
+            resize_ratio=args.resize_ratio,
+            tokenizer=tokenizer,
+            shuffle=True,
+            seed=args.seed,
+        )
+        assert len(dataset) > 0, f"no image-text pairs found at {args.image_text_folder}"
+        loader = DataLoader(
+            dataset,
+            args.batch_size,
+            shuffle=True,
+            seed=args.seed,
+            process_index=runtime.process_index,
+            process_count=runtime.process_count,
+        )
+
+    logger = MetricsLogger(
+        project="dalle_train_transformer",
+        run_name=args.wandb_name,
+        config=vars(args),
+        enabled=runtime.is_root_worker(),
+        use_wandb=args.wandb,
+    )
+
+    # ---- params / optimizer / compiled step ------------------------------
+    text0 = jnp.zeros((1, dalle.text_seq_len), jnp.int32)
+    image0 = jnp.zeros((1, dalle.image_seq_len), jnp.int32)
+    if resume_params is not None:
+        params = resume_params
+    else:
+        params = jax.jit(dalle.init)(jax.random.key(args.seed), text0, image0)["params"]
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+    logger.log_text(
+        f"DALLE {n_params:,} params | seq {dalle.total_seq_len} | "
+        f"mesh {dict(runtime.mesh.shape)}"
+    )
+
+    optimizer = optax.chain(
+        optax.clip_by_global_norm(args.clip_grad_norm),
+        optax.scale_by_adam(),
+    )
+    if args.ga_steps > 1:
+        optimizer = optax.MultiSteps(optimizer, every_k_schedule=args.ga_steps)
+    state, shardings = create_train_state(params, optimizer, runtime)
+    if args.dalle_path:
+        # keep Adam moments across resume (reference restores opt_state,
+        # train_dalle.py:419-426)
+        from dalle_pytorch_tpu.models.factory import restore_opt_state
+        from dalle_pytorch_tpu.parallel import shard_pytree
+
+        host_opt = restore_opt_state(
+            args.dalle_path, jax.tree_util.tree_map(np.asarray, state.opt_state)
+        )
+        if host_opt is not None:
+            state = state._replace(
+                opt_state=shard_pytree(host_opt, shardings.opt_state)
+            )
+    del params, resume_params
+
+    vae_encode = jax.jit(
+        lambda img: vae.apply(
+            {"params": vae_params}, img, method=DiscreteVAE.get_codebook_indices
+        ),
+        out_shardings=runtime.data_sharding,
+    )
+
+    def loss_fn(p, batch, rng):
+        return dalle.apply(
+            {"params": p},
+            batch["text"],
+            batch["image"],
+            return_loss=True,
+            deterministic=(args.attn_dropout == 0 and args.ff_dropout == 0),
+            rngs={"dropout": rng},
+        )
+
+    step_fn = make_train_step(
+        loss_fn, optimizer, runtime, shardings, dynamic_lr=True
+    )
+
+    sched = (
+        ReduceLROnPlateau(args.learning_rate)
+        if args.lr_decay
+        else ConstantLR(args.learning_rate)
+    )
+    if sched_state:
+        sched.load_state_dict(sched_state)
+    lr = sched.lr
+
+    ckpt_path = f"{args.dalle_output_file_name}.ckpt"
+
+    def save(epoch):
+        # gather is a collective — every process participates; only the
+        # root writes the file
+        host_params = runtime.to_host(state.params)
+        host_opt = runtime.to_host(state.opt_state)
+        if not runtime.is_root_worker():
+            return
+        save_dalle_checkpoint(
+            ckpt_path, dalle, host_params, vae, vae_params,
+            extra={"epoch": epoch, "scheduler_state": sched.state_dict()},
+            opt_state=host_opt, step=int(state.step),
+        )
+
+    def save_sharded(step):
+        if args.sharded_ckpt:
+            save_sharded_checkpoint(
+                f"{args.dalle_output_file_name}-cp", step, state,
+                meta={"epoch": epoch}, keep_n=args.keep_n_checkpoints,
+            )
+
+    # pre-flight save: fail early when misconfigured (train_dalle.py:561-563)
+    save(start_epoch - 1)
+
+    throughput = Throughput(window=10)
+    global_step = 0
+    for epoch in range(start_epoch, args.epochs):
+        for i, batch in enumerate(loader):
+            image_tokens = vae_encode(batch["image"])
+            train_batch = {
+                "text": jnp.asarray(batch["text"]),
+                "image": image_tokens,
+            }
+            state, loss = step_fn(
+                state, train_batch, jax.random.key(global_step), jnp.asarray(lr)
+            )
+
+            if global_step % 10 == 0:
+                loss_v = float(loss)
+                logger.log(
+                    {"loss": loss_v, "epoch": epoch, "iter": i, "lr": lr},
+                    step=global_step,
+                )
+                lr = sched.step(loss_v)
+            rate = throughput.update(args.batch_size)
+            if rate is not None:
+                logger.log({"sample_per_sec": rate}, step=global_step)
+
+            if global_step > 0 and global_step % args.save_every_n_steps == 0:
+                save(epoch)
+                save_sharded(global_step)
+
+            if global_step > 0 and global_step % args.sample_every_n_steps == 0:
+                # sampling over sharded params is collective: all processes
+                # run it; only the root writes the image
+                images = generate_images(
+                    dalle, state.params, vae, {"params": vae_params},
+                    train_batch["text"][:1], jax.random.key(global_step),
+                )
+                if runtime.is_root_worker():
+                    from PIL import Image
+
+                    out = Path("dalle_samples")
+                    out.mkdir(exist_ok=True)
+                    arr = (np.asarray(images[0]).clip(0, 1) * 255).astype(np.uint8)
+                    Image.fromarray(arr).save(out / f"sample_{global_step:07d}.png")
+                    logger.log_images("samples", np.asarray(images), step=global_step)
+
+            global_step += 1
+
+        save(epoch)
+        save_sharded(global_step)
+        logger.log_text(f"epoch {epoch} complete")
+
+    logger.finish()
+
+
+if __name__ == "__main__":
+    main()
