@@ -31,9 +31,26 @@ import jax.numpy as jnp
 import numpy as np
 import flax.linen as nn
 
+import functools
+
 from . import masks as masks_lib
+from .flash_attention import StaticMask, flash_attention
 from .layers import stable_softmax
 from .rotary import apply_rotary_emb
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_flash_mask(module: "PatternAttention", n: int) -> StaticMask:
+    """One StaticMask per (module config, n) — flax modules are frozen
+    hashable dataclasses, so this builds each layer's mask exactly once."""
+    return StaticMask(module.pattern_mask()[:n, :n])
+
+
+def _flash_block(n: int) -> int:
+    for b in (128, 64, 32):
+        if n % b == 0:
+            return b
+    return 0
 
 Dtype = Any
 
@@ -84,6 +101,7 @@ class PatternAttention(nn.Module):
     block_size: int = 16
     num_random_blocks: Optional[int] = None
     layout_seed: int = 0
+    use_flash: bool = True
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -145,20 +163,49 @@ class PatternAttention(nn.Module):
             if rotary_pos_emb is not None:
                 table = rotary_pos_emb[:n][None, None]  # (1, 1, n, rot)
                 q, k, v = (apply_rotary_emb(table, t) for t in (q, k, v))
-            q = q * (d**-0.5)
 
-            if force_dense:
-                out = self._dense_attend(q, k, v, mask)
-            elif self.attn_type in ("axial_row", "axial_col"):
-                out = self._axial_attend(q, k, v, mask)
-            elif self.attn_type == "conv_like":
-                out = self._conv_attend(q, k, v, mask)
+            if (
+                self.use_flash
+                and not force_dense
+                and mask is None
+                and self.attn_type in ("full", "sparse")
+                and _flash_block(n) > 0
+            ):
+                out = self._flash_attend(q, k, v, n)
             else:
-                out = self._dense_attend(q, k, v, mask)
+                q = q * (d**-0.5)
+                if force_dense:
+                    out = self._dense_attend(q, k, v, mask)
+                elif self.attn_type in ("axial_row", "axial_col"):
+                    out = self._axial_attend(q, k, v, mask)
+                elif self.attn_type == "conv_like":
+                    out = self._conv_attend(q, k, v, mask)
+                else:
+                    out = self._dense_attend(q, k, v, mask)
 
         out = out.transpose(0, 2, 1, 3).reshape(b, -1, inner)
         out = nn.Dense(self.dim, dtype=self.dtype, param_dtype=self.param_dtype, name="to_out")(out)
         return nn.Dropout(self.dropout)(out, deterministic=deterministic)
+
+    # ------------------------------------------------------------ flash path
+
+    def _flash_attend(self, q, k, v, n: int):
+        """Fused Pallas kernel for the dense-causal and block-sparse patterns
+        (ops/flash_attention.py): O(n·d) memory, per-block skip of masked-out
+        regions. Falls back to interpret mode off-TPU so tests run anywhere."""
+        block = _flash_block(n)
+        pattern = None
+        if self.attn_type == "sparse" or not self.causal:
+            pattern = _cached_flash_mask(self, n)
+        return flash_attention(
+            q, k, v,
+            causal=self.causal,
+            pattern_mask=pattern,
+            sm_scale=self.dim_head**-0.5,
+            block_q=block,
+            block_k=block,
+            interpret=jax.devices()[0].platform != "tpu",
+        )
 
     # ------------------------------------------------------------ dense paths
 

@@ -1,0 +1,464 @@
+"""Fused (flash-style) attention Pallas TPU kernels.
+
+The dense attention path materializes the (n, n) score matrix in HBM — at
+DALL-E's seq 1280 that is the memory wall that caps batch size (and the
+reference's DeepSpeed block-sparse CUDA kernel exists for the same reason,
+attention.py:325-384). These kernels stream K/V blocks through VMEM with an
+online-softmax accumulator, so activation memory is O(n·d) while the MXU sees
+full (block_q x d x block_k) matmuls:
+
+- forward: grid (b·h, n/bq, n/bk); the innermost k dimension iterates
+  sequentially with running (max, denom, unnormalized out) in VMEM scratch;
+  emits per-row logsumexp for the backward;
+- backward: recompute-based (FlashAttention-2 decomposition, no stored
+  probabilities): one kernel accumulates dq over k blocks, another (dk, dv)
+  over q blocks;
+- masking: ``causal=True`` is analytic (above-diagonal blocks contribute no
+  FLOPs and their K/V DMAs are elided by re-fetching the previous live
+  block); an optional static (n, n) pattern mask (ops/masks.py) is streamed
+  blockwise for sparse/axial/conv layouts with all-empty blocks skipped the
+  same way. This one kernel therefore covers both the reference's dense
+  causal attention and its DeepSpeed variable-sparsity kernel semantics.
+
+Parity is tested against the dense masked oracle (ops.attention.dense_attend)
+in interpret mode on CPU and compiled on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+class StaticMask:
+    """Hashable wrapper for a static (n, n) bool may-attend mask, so it can
+    ride through custom_vjp/jit static arguments without retracing (identity
+    hash — build once per model, e.g. via a cached constructor)."""
+
+    def __init__(self, mask):
+        self.mask = np.asarray(mask, dtype=bool)
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+
+# --------------------------------------------------------------- static maps
+
+
+def _block_visit_map(
+    nq: int, nk: int, block_q: int, block_k: int,
+    causal: bool, pattern_mask: Optional[np.ndarray],
+) -> np.ndarray:
+    """Static per-(qb, kb) class: 0 = skip, 1 = needs masking, 2 = dense."""
+    visit = np.full((nq, nk), 2, dtype=np.int32)
+    if pattern_mask is not None:
+        for qb in range(nq):
+            for kb in range(nk):
+                blk = pattern_mask[
+                    qb * block_q : (qb + 1) * block_q,
+                    kb * block_k : (kb + 1) * block_k,
+                ]
+                visit[qb, kb] = 0 if not blk.any() else (2 if blk.all() else 1)
+    elif causal:
+        for qb in range(nq):
+            for kb in range(nk):
+                if kb * block_k > (qb + 1) * block_q - 1:
+                    visit[qb, kb] = 0  # fully above the diagonal
+                elif (kb + 1) * block_k - 1 > qb * block_q:
+                    visit[qb, kb] = 1  # diagonal-crossing
+    return visit
+
+
+def _last_live_table(visit: np.ndarray) -> np.ndarray:
+    """For each grid step, the most recent live inner-index — skipped steps
+    re-fetch that block so their DMA costs nothing new."""
+    out = np.zeros_like(visit)
+    for a in range(visit.shape[0]):
+        live = 0
+        for b in range(visit.shape[1]):
+            if visit[a, b] > 0:
+                live = b
+            out[a, b] = live
+    return out
+
+
+# ------------------------------------------------------------------ kernels
+
+
+def _masked_scores(q, k, mask_ref, visit, row0, col0, bq, bk):
+    """(bq, bk) f32 scores with pattern/causal masking applied."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if mask_ref is not None:
+        return jnp.where(mask_ref[:] > 0, s, NEG_INF)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + row0
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + col0
+    return jnp.where(jnp.logical_or(visit == 2, rows >= cols), s, NEG_INF)
+
+
+def _row_vec(ref):
+    """(1, 1, bq) ref block -> (bq, 1) f32."""
+    return jax.lax.transpose(ref[0], (1, 0))
+
+
+def _fwd_kernel(
+    visit_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+    m_scr, l_scr, acc_scr,
+    *, sm_scale, block_q, block_k, nk,
+):
+    qb, kb = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    visit = visit_ref[qb * nk + kb]
+
+    @pl.when(visit > 0)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        s = _masked_scores(
+            q, k_ref[0].astype(jnp.float32), mask_ref, visit,
+            qb * block_q, kb * block_k, block_q, block_k,
+        )
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:, 0:1] = l_scr[:, 0:1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[:, 0:1] = m_new
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kb == nk - 1)
+    def _():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0 output
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = m_scr[:, 0:1] + jnp.log(l_safe)  # (bq, 1)
+        lse_ref[0] = jax.lax.transpose(lse, (1, 0))
+
+
+def _bwd_dq_kernel(
+    visit_ref, q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_scr,
+    *, sm_scale, block_q, block_k, nk,
+):
+    qb, kb = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    visit = visit_ref[qb * nk + kb]
+
+    @pl.when(visit > 0)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _masked_scores(
+            q, k, mask_ref, visit, qb * block_q, kb * block_k, block_q, block_k
+        )
+        p = jnp.exp(s - _row_vec(lse_ref))
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - _row_vec(delta_ref)) * sm_scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(kb == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    visit_t_ref, q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_scr, dv_scr,
+    *, sm_scale, block_q, block_k, nq,
+):
+    kb, qb = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qb == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    visit = visit_t_ref[kb * nq + qb]
+
+    @pl.when(visit > 0)
+    def _():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _masked_scores(
+            q, k, mask_ref, visit, qb * block_q, kb * block_k, block_q, block_k
+        )
+        p = jnp.exp(s - _row_vec(lse_ref))  # (bq, bk)
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - _row_vec(delta_ref))  # (bq, bk)
+        # dk += ds^T @ (q * sm_scale): fold the scale back out of q once
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qb == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# ------------------------------------------------------------------ plumbing
+
+
+def _prep(q, pattern_mask, block_q, block_k, causal):
+    b, h, n, d = q.shape
+    assert n % block_q == 0 and n % block_k == 0, (
+        f"seq {n} must divide block sizes ({block_q}, {block_k})"
+    )
+    nq, nk = n // block_q, n // block_k
+    mask_np = None
+    if pattern_mask is not None:
+        assert isinstance(pattern_mask, StaticMask), (
+            "wrap the pattern mask in StaticMask (hashable static argument)"
+        )
+        mask_np = pattern_mask.mask
+        assert mask_np.shape == (n, n), (mask_np.shape, n)
+    visit = _block_visit_map(nq, nk, block_q, block_k, causal, mask_np)
+    return b, h, n, d, nq, nk, mask_np, visit
+
+
+def _call(kernel, grid, in_specs, out_specs, out_shape, scratch, scalar, operands, interpret):
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            scratch_shapes=scratch,
+        ),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(scalar, *operands)
+
+
+def _with_optional_mask(kernel, has_mask, n_out, n_scratch):
+    """Adapt a kernel with a mask_ref slot to calls without a mask operand."""
+
+    def wrapped(*refs):
+        if has_mask:
+            return kernel(*refs)
+        split = len(refs) - n_out - n_scratch
+        ins = refs[:split]
+        rest = refs[split:]
+        return kernel(*ins[:4], None, *ins[4:], *rest)
+
+    return wrapped
+
+
+def _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interpret):
+    b, h, n, d, nq, nk, mask_np, visit = _prep(q, pattern_mask, block_q, block_k, causal)
+    scale = d**-0.5 if sm_scale is None else sm_scale
+    bh = b * h
+    qf, kf, vf = (t.reshape(bh, n, d) for t in (q, k, v))
+
+    kv_table = jnp.asarray(_last_live_table(visit))
+
+    def kv_im(bhi, qb, kb):
+        return (bhi, kv_table[qb, kb], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb: (bhi, qb, 0)),
+        pl.BlockSpec((1, block_k, d), kv_im),
+        pl.BlockSpec((1, block_k, d), kv_im),
+    ]
+    operands = [qf, kf, vf]
+    if mask_np is not None:
+        in_specs.append(pl.BlockSpec((block_q, block_k), lambda bhi, qb, kb: (qb, kb)))
+        operands.append(jnp.asarray(mask_np, jnp.int8))
+
+    kernel = _with_optional_mask(
+        functools.partial(
+            _fwd_kernel, sm_scale=scale, block_q=block_q, block_k=block_k, nk=nk
+        ),
+        mask_np is not None,
+        n_out=2,
+        n_scratch=3,
+    )
+    o, lse = _call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb: (bhi, qb, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb: (bhi, 0, qb)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, n), jnp.float32),
+        ],
+        scratch=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        scalar=visit.reshape(-1),
+        operands=operands,
+        interpret=interpret,
+    )
+    return o.reshape(b, h, n, d), lse.reshape(b, h, n)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def flash_attention(
+    q, k, v,
+    causal: bool = True,
+    pattern_mask=None,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Fused attention over (b, h, n, d); q is NOT pre-scaled (``sm_scale``
+    defaults to d**-0.5). ``pattern_mask``: static (n, n) bool array,
+    True = may attend; hash by id, so build it once at model setup."""
+    o, _ = _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interpret)
+    return o
+
+
+def _fwd_rule(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    b, h, n, d, nq, nk, mask_np, visit = _prep(q, pattern_mask, block_q, block_k, causal)
+    scale = d**-0.5 if sm_scale is None else sm_scale
+    bh = b * h
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    qf, kf, vf, dof = (t.reshape(bh, n, d) for t in (q, k, v, do))
+    lsef = lse.reshape(bh, 1, n)
+    deltaf = delta.reshape(bh, 1, n)
+    mask_op = [] if mask_np is None else [jnp.asarray(mask_np, jnp.int8)]
+
+    # ---- dq over k blocks --------------------------------------------------
+    kv_table = jnp.asarray(_last_live_table(visit))
+
+    def kv_im(bhi, qb, kb):
+        return (bhi, kv_table[qb, kb], 0)
+
+    dq_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb: (bhi, qb, 0)),
+        pl.BlockSpec((1, block_k, d), kv_im),
+        pl.BlockSpec((1, block_k, d), kv_im),
+        *(
+            [pl.BlockSpec((block_q, block_k), lambda bhi, qb, kb: (qb, kb))]
+            if mask_np is not None else []
+        ),
+        pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb: (bhi, qb, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb: (bhi, 0, qb)),
+        pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb: (bhi, 0, qb)),
+    ]
+    dq_kernel = _with_optional_mask(
+        functools.partial(
+            _bwd_dq_kernel, sm_scale=scale, block_q=block_q, block_k=block_k, nk=nk
+        ),
+        mask_np is not None,
+        n_out=1,
+        n_scratch=1,
+    )
+    (dq,) = _call(
+        dq_kernel,
+        grid=(bh, nq, nk),
+        in_specs=dq_specs,
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb: (bhi, qb, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, n, d), q.dtype)],
+        scratch=[pltpu.VMEM((block_q, d), jnp.float32)],
+        scalar=visit.reshape(-1),
+        operands=[qf, kf, vf, *mask_op, dof, lsef, deltaf],
+        interpret=interpret,
+    )
+
+    # ---- dk/dv over q blocks ----------------------------------------------
+    visit_t = np.ascontiguousarray(visit.T)
+    q_table = jnp.asarray(_last_live_table(visit_t))
+
+    def q_im(bhi, kb, qb):
+        return (bhi, q_table[kb, qb], 0)
+
+    def row_im(bhi, kb, qb):
+        return (bhi, 0, q_table[kb, qb])
+
+    dkv_specs = [
+        pl.BlockSpec((1, block_q, d), q_im),
+        pl.BlockSpec((1, block_k, d), lambda bhi, kb, qb: (bhi, kb, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bhi, kb, qb: (bhi, kb, 0)),
+        *(
+            [pl.BlockSpec((block_q, block_k), lambda bhi, kb, qb: (qb, kb))]
+            if mask_np is not None else []
+        ),
+        pl.BlockSpec((1, block_q, d), q_im),
+        pl.BlockSpec((1, 1, block_q), row_im),
+        pl.BlockSpec((1, 1, block_q), row_im),
+    ]
+    dkv_kernel = _with_optional_mask(
+        functools.partial(
+            _bwd_dkv_kernel, sm_scale=scale, block_q=block_q, block_k=block_k, nq=nq
+        ),
+        mask_np is not None,
+        n_out=2,
+        n_scratch=2,
+    )
+    dk, dv = _call(
+        dkv_kernel,
+        grid=(bh, nk, nq),
+        in_specs=dkv_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bhi, kb, qb: (bhi, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, kb, qb: (bhi, kb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+        ],
+        scratch=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        scalar=visit_t.reshape(-1),
+        operands=[qf, kf, vf, *mask_op, dof, lsef, deltaf],
+        interpret=interpret,
+    )
+    return dq.reshape(b, h, n, d), dk.reshape(b, h, n, d), dv.reshape(b, h, n, d)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)
