@@ -94,6 +94,16 @@ def _last_live_table(visit: np.ndarray) -> np.ndarray:
     return out
 
 
+def _scalar_table(visit: np.ndarray) -> np.ndarray:
+    """(2, nq*nk) int32 scalar-prefetch payload: row 0 = per-(outer, inner)
+    visit class consumed by the kernel body, row 1 = last-live inner index
+    consumed by the K/V index_maps (skipped steps re-fetch the previous live
+    block, so their DMA is a no-op)."""
+    return np.stack(
+        [visit.reshape(-1), _last_live_table(visit).reshape(-1)]
+    ).astype(np.int32)
+
+
 # ------------------------------------------------------------------ kernels
 
 
@@ -114,8 +124,16 @@ def _row_vec(ref):
     return jax.lax.transpose(ref[0], (1, 0))
 
 
+def _masked_exp(s, x):
+    """exp(s - x) with fully-masked entries forced to 0: rows masked in every
+    visited block keep their running max / lse at NEG_INF, where exp(s - x)
+    would be 1 — the guard enforces the 'fully-masked rows -> 0 output'
+    contract (threshold is unreachable by real scores)."""
+    return jnp.where(s > 0.5 * NEG_INF, jnp.exp(s - x), 0.0)
+
+
 def _fwd_kernel(
-    visit_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+    scalar_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
     m_scr, l_scr, acc_scr,
     *, sm_scale, block_q, block_k, nk,
 ):
@@ -127,7 +145,7 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    visit = visit_ref[qb * nk + kb]
+    visit = scalar_ref[0, qb * nk + kb]
 
     @pl.when(visit > 0)
     def _():
@@ -138,7 +156,7 @@ def _fwd_kernel(
         )
         m_prev = m_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        p = _masked_exp(s, m_new)
         corr = jnp.exp(m_prev - m_new)
         l_scr[:, 0:1] = l_scr[:, 0:1] * corr + jnp.sum(p, axis=-1, keepdims=True)
         m_scr[:, 0:1] = m_new
@@ -157,7 +175,7 @@ def _fwd_kernel(
 
 
 def _bwd_dq_kernel(
-    visit_ref, q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+    scalar_ref, q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     dq_ref, dq_scr,
     *, sm_scale, block_q, block_k, nk,
 ):
@@ -167,7 +185,7 @@ def _bwd_dq_kernel(
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    visit = visit_ref[qb * nk + kb]
+    visit = scalar_ref[0, qb * nk + kb]
 
     @pl.when(visit > 0)
     def _():
@@ -178,7 +196,7 @@ def _bwd_dq_kernel(
         s = _masked_scores(
             q, k, mask_ref, visit, qb * block_q, kb * block_k, block_q, block_k
         )
-        p = jnp.exp(s - _row_vec(lse_ref))
+        p = _masked_exp(s, _row_vec(lse_ref))
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -193,7 +211,7 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    visit_t_ref, q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+    scalar_ref, q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_scr, dv_scr,
     *, sm_scale, block_q, block_k, nq,
 ):
@@ -204,7 +222,7 @@ def _bwd_dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    visit = visit_t_ref[kb * nq + qb]
+    visit = scalar_ref[0, kb * nq + qb]
 
     @pl.when(visit > 0)
     def _():
@@ -215,7 +233,7 @@ def _bwd_dkv_kernel(
         s = _masked_scores(
             q, k, mask_ref, visit, qb * block_q, kb * block_k, block_q, block_k
         )
-        p = jnp.exp(s - _row_vec(lse_ref))  # (bq, bk)
+        p = _masked_exp(s, _row_vec(lse_ref))
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -289,19 +307,22 @@ def _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interp
     bh = b * h
     qf, kf, vf = (t.reshape(bh, n, d) for t in (q, k, v))
 
-    kv_table = jnp.asarray(_last_live_table(visit))
-
-    def kv_im(bhi, qb, kb):
-        return (bhi, kv_table[qb, kb], 0)
+    # index_maps under PrefetchScalarGridSpec receive the scalar-prefetch ref
+    # as a trailing argument after the grid indices; K/V block selection reads
+    # the last-live table out of it (row 1)
+    def kv_im(bhi, qb, kb, s):
+        return (bhi, s[1, qb * nk + kb], 0)
 
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb: (bhi, qb, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0)),
         pl.BlockSpec((1, block_k, d), kv_im),
         pl.BlockSpec((1, block_k, d), kv_im),
     ]
     operands = [qf, kf, vf]
     if mask_np is not None:
-        in_specs.append(pl.BlockSpec((block_q, block_k), lambda bhi, qb, kb: (qb, kb)))
+        in_specs.append(
+            pl.BlockSpec((block_q, block_k), lambda bhi, qb, kb, s: (qb, kb))
+        )
         operands.append(jnp.asarray(mask_np, jnp.int8))
 
     kernel = _with_optional_mask(
@@ -317,8 +338,8 @@ def _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interp
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb: (bhi, qb, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb: (bhi, 0, qb)),
+            pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb, s: (bhi, 0, qb)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, n, d), q.dtype),
@@ -329,7 +350,7 @@ def _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interp
             pltpu.VMEM((block_q, LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        scalar=visit.reshape(-1),
+        scalar=jnp.asarray(_scalar_table(visit)),
         operands=operands,
         interpret=interpret,
     )
@@ -371,22 +392,20 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
     mask_op = [] if mask_np is None else [jnp.asarray(mask_np, jnp.int8)]
 
     # ---- dq over k blocks --------------------------------------------------
-    kv_table = jnp.asarray(_last_live_table(visit))
-
-    def kv_im(bhi, qb, kb):
-        return (bhi, kv_table[qb, kb], 0)
+    def kv_im(bhi, qb, kb, s):
+        return (bhi, s[1, qb * nk + kb], 0)
 
     dq_specs = [
-        pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb: (bhi, qb, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0)),
         pl.BlockSpec((1, block_k, d), kv_im),
         pl.BlockSpec((1, block_k, d), kv_im),
         *(
-            [pl.BlockSpec((block_q, block_k), lambda bhi, qb, kb: (qb, kb))]
+            [pl.BlockSpec((block_q, block_k), lambda bhi, qb, kb, s: (qb, kb))]
             if mask_np is not None else []
         ),
-        pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb: (bhi, qb, 0)),
-        pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb: (bhi, 0, qb)),
-        pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb: (bhi, 0, qb)),
+        pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb, s: (bhi, 0, qb)),
+        pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb, s: (bhi, 0, qb)),
     ]
     dq_kernel = _with_optional_mask(
         functools.partial(
@@ -400,30 +419,31 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
         dq_kernel,
         grid=(bh, nq, nk),
         in_specs=dq_specs,
-        out_specs=[pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb: (bhi, qb, 0))],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0))
+        ],
         out_shape=[jax.ShapeDtypeStruct((bh, n, d), q.dtype)],
         scratch=[pltpu.VMEM((block_q, d), jnp.float32)],
-        scalar=visit.reshape(-1),
+        scalar=jnp.asarray(_scalar_table(visit)),
         operands=[qf, kf, vf, *mask_op, dof, lsef, deltaf],
         interpret=interpret,
     )
 
     # ---- dk/dv over q blocks ----------------------------------------------
     visit_t = np.ascontiguousarray(visit.T)
-    q_table = jnp.asarray(_last_live_table(visit_t))
 
-    def q_im(bhi, kb, qb):
-        return (bhi, q_table[kb, qb], 0)
+    def q_im(bhi, kb, qb, s):
+        return (bhi, s[1, kb * nq + qb], 0)
 
-    def row_im(bhi, kb, qb):
-        return (bhi, 0, q_table[kb, qb])
+    def row_im(bhi, kb, qb, s):
+        return (bhi, 0, s[1, kb * nq + qb])
 
     dkv_specs = [
         pl.BlockSpec((1, block_q, d), q_im),
-        pl.BlockSpec((1, block_k, d), lambda bhi, kb, qb: (bhi, kb, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bhi, kb, qb: (bhi, kb, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bhi, kb, qb, s: (bhi, kb, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bhi, kb, qb, s: (bhi, kb, 0)),
         *(
-            [pl.BlockSpec((block_q, block_k), lambda bhi, kb, qb: (qb, kb))]
+            [pl.BlockSpec((block_q, block_k), lambda bhi, kb, qb, s: (qb, kb))]
             if mask_np is not None else []
         ),
         pl.BlockSpec((1, block_q, d), q_im),
@@ -443,8 +463,8 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
         grid=(bh, nk, nq),
         in_specs=dkv_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bhi, kb, qb: (bhi, kb, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, kb, qb: (bhi, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, kb, qb, s: (bhi, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, kb, qb, s: (bhi, kb, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, n, d), q.dtype),
@@ -454,7 +474,7 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        scalar=visit_t.reshape(-1),
+        scalar=jnp.asarray(_scalar_table(visit_t)),
         operands=[qf, kf, vf, *mask_op, dof, lsef, deltaf],
         interpret=interpret,
     )
