@@ -41,22 +41,15 @@ _BPE_FILENAME = "bpe_simple_vocab_16e6.txt"
 
 
 def default_bpe_path() -> Optional[str]:
-    """Locate the standard CLIP BPE merges file."""
+    """Locate the standard CLIP BPE merges file. The vocab is vendored with
+    the package (like the reference's MANIFEST.in:1 shipping
+    dalle_pytorch/data/bpe_simple_vocab_16e6.txt), so the package-relative
+    path always resolves for a normal install/checkout."""
     candidates = [
         os.environ.get("DALLE_TPU_BPE_PATH"),
         str(Path(__file__).parent / _BPE_FILENAME),
         str(Path.home() / ".cache" / "dalle_tpu" / _BPE_FILENAME),
     ]
-    # an existing dalle-pytorch checkout/install also carries it
-    try:
-        import dalle_pytorch  # type: ignore
-
-        candidates.append(
-            str(Path(dalle_pytorch.__file__).parent / "data" / _BPE_FILENAME)
-        )
-    except ImportError:
-        pass
-    candidates.append(f"/root/reference/dalle_pytorch/data/{_BPE_FILENAME}")
     for c in candidates:
         if c and os.path.exists(c):
             return c
@@ -127,7 +120,13 @@ class _TokenizeMixin:
 
 class SimpleTokenizer(_TokenizeMixin):
     """Byte-level BPE over the bundled 16e6 merges vocabulary (49408 tokens),
-    drop-in for the reference's SimpleTokenizer (tokenizer.py:20-154)."""
+    drop-in for the reference's SimpleTokenizer (tokenizer.py:20-154).
+
+    Algorithm ancestry: this follows OpenAI's MIT-licensed CLIP tokenizer
+    (which the reference vendors verbatim) — byte-exact vocab compatibility
+    pins the merges slicing, vocab assembly order, regex pattern, and the
+    greedy lowest-rank merge loop, so the implementation necessarily mirrors
+    that public code rather than being an independent design."""
 
     def __init__(self, bpe_path: Optional[str] = None):
         bpe_path = bpe_path or default_bpe_path()

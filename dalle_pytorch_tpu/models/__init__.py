@@ -1,5 +1,6 @@
 from .clip import CLIP, masked_mean
 from .dalle import DALLE, top_k_filter
+from .pretrained import OpenAIDiscreteVAE
 from .sampling import (
     decode_tokens,
     generate_image_tokens,
@@ -8,14 +9,18 @@ from .sampling import (
     init_decode_cache,
 )
 from .transformer import Transformer
-from .vae import DiscreteVAE, ResBlock, gumbel_softmax, smooth_l1_loss
+from .vae import DiscreteVAE, ResBlock, denormalize, gumbel_softmax, smooth_l1_loss
+from .vqgan import VQGanVAE
 
 __all__ = [
     "CLIP",
     "DALLE",
     "DiscreteVAE",
+    "OpenAIDiscreteVAE",
     "ResBlock",
     "Transformer",
+    "VQGanVAE",
+    "denormalize",
     "decode_tokens",
     "generate_image_tokens",
     "generate_images",

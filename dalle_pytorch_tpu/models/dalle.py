@@ -68,6 +68,7 @@ class DALLE(nn.Module):
     rotary_emb: bool = True
     remat: bool = False
     sparse_layout_seed: int = 0
+    use_flash: bool = True
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -142,6 +143,7 @@ class DALLE(nn.Module):
             rotary_emb=self.rotary_emb,
             remat=self.remat,
             sparse_layout_seed=self.sparse_layout_seed,
+            use_flash=self.use_flash,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )

@@ -16,9 +16,60 @@ import jax.numpy as jnp
 
 from ..utils.checkpoint import load_checkpoint, save_checkpoint
 from .dalle import DALLE
+from .pretrained import OpenAIDiscreteVAE
 from .vae import DiscreteVAE
 
 _DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+def vae_classes() -> dict:
+    """Name -> class for every VAE family a checkpoint may carry (the
+    reference's generate.py:86-91 three-way switch)."""
+    from .vqgan import VQGanVAE
+
+    return {
+        "DiscreteVAE": DiscreteVAE,
+        "OpenAIDiscreteVAE": OpenAIDiscreteVAE,
+        "VQGanVAE": VQGanVAE,
+    }
+
+
+def deep_merge(a: dict, b: dict) -> dict:
+    """Recursive dict merge (b wins on leaves) — sub-path inits (encode-only
+    / decode-only) can both contribute children to the same submodule."""
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = (
+            deep_merge(out[k], v)
+            if isinstance(v, dict) and isinstance(out.get(k), dict)
+            else v
+        )
+    return out
+
+
+def init_vae_params(vae) -> Any:
+    """A zeroed param tree with the right structure for ``vae`` — the
+    from_state_dict restore target. Trainable DiscreteVAE inits through
+    __call__ (needs a gumbel key); frozen wrappers init their enc/dec paths
+    via the method-based entry points."""
+    import jax
+
+    key = jax.random.key(0)
+    if isinstance(vae, DiscreteVAE):
+        img = jnp.zeros((1, vae.image_size, vae.image_size, vae.channels))
+        shapes = jax.eval_shape(
+            lambda: vae.init({"params": key, "gumbel": key}, img)
+        )["params"]
+    else:
+        img = jnp.zeros((1, vae.image_size, vae.image_size, 3))
+        seq = jnp.zeros((1, vae.image_seq_len), jnp.int32)
+        shapes = deep_merge(
+            jax.eval_shape(
+                lambda: vae.init(key, img, method="get_codebook_indices")
+            )["params"],
+            jax.eval_shape(lambda: vae.init(key, seq, method="decode"))["params"],
+        )
+    return jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
 def _config_dict(module) -> dict:
@@ -52,24 +103,26 @@ def _restore_dtypes(cfg: dict) -> dict:
 # ------------------------------------------------------------------- VAE
 
 
-def save_vae_checkpoint(path: str, vae: DiscreteVAE, params: Any, extra: Optional[dict] = None):
-    meta = {"model_class": "DiscreteVAE", "config": _config_dict(vae), **(extra or {})}
+def save_vae_checkpoint(path: str, vae, params: Any, extra: Optional[dict] = None):
+    meta = {
+        "model_class": type(vae).__name__,
+        "config": _config_dict(vae),
+        **(extra or {}),
+    }
     save_checkpoint(path, {"params": params}, meta)
 
 
-def vae_from_checkpoint(path: str) -> Tuple[DiscreteVAE, Any, dict]:
+def vae_from_checkpoint(path: str) -> Tuple[Any, Any, dict]:
     state, meta = load_checkpoint(path)
-    assert meta.get("model_class") == "DiscreteVAE", (
-        f"not a DiscreteVAE checkpoint: {meta.get('model_class')}"
-    )
-    vae = DiscreteVAE(**_restore_dtypes(meta["config"]))
-    params = vae.init(
-        {"params": __import__("jax").random.key(0), "gumbel": __import__("jax").random.key(0)},
-        jnp.zeros((1, vae.image_size, vae.image_size, vae.channels)),
-    )["params"]
+    classes = vae_classes()
+    cls = classes.get(meta.get("model_class"))
+    assert cls is not None, f"not a VAE checkpoint: {meta.get('model_class')}"
+    vae = cls(**_restore_dtypes(meta["config"]))
     from flax import serialization
 
-    params = serialization.from_state_dict(params, state["params"])
+    params = serialization.from_state_dict(
+        init_vae_params(vae), state["params"]
+    )
     return vae, params, meta
 
 
@@ -138,11 +191,10 @@ def dalle_from_checkpoint(path: str):
 
     vae = vae_params = None
     if "vae_config" in meta:
-        assert meta.get("vae_class") == "DiscreteVAE", meta.get("vae_class")
-        vae = DiscreteVAE(**_restore_dtypes(meta["vae_config"]))
-        vp = vae.init(
-            {"params": jax.random.key(0), "gumbel": jax.random.key(0)},
-            jnp.zeros((1, vae.image_size, vae.image_size, vae.channels)),
-        )["params"]
-        vae_params = serialization.from_state_dict(vp, state["vae_params"])
+        cls = vae_classes().get(meta.get("vae_class"))
+        assert cls is not None, f"unknown VAE class {meta.get('vae_class')}"
+        vae = cls(**_restore_dtypes(meta["vae_config"]))
+        vae_params = serialization.from_state_dict(
+            init_vae_params(vae), state["vae_params"]
+        )
     return dalle, params, vae, vae_params, meta

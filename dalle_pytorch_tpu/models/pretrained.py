@@ -1,0 +1,438 @@
+"""Pretrained-VAE wrappers: OpenAI discrete VAE, re-owned in flax.
+
+Capability parity with the reference's ``OpenAIDiscreteVAE``
+(vae.py:103-133): fixed props num_layers=3 / image_size=256 /
+num_tokens=8192, ``map_pixels``/``unmap_pixels`` 0.1-eps remap
+(vae.py:47-51), encode = argmax over encoder logits (vae.py:115-120),
+decode = one-hot -> decoder -> sigmoid over the first 3 of 6 output
+channels (vae.py:122-130), and ``__call__`` raising because the model is
+frozen and inference-only (vae.py:132-133).
+
+The reference unpickles OpenAI's published encoder/decoder nn.Modules
+through the ``DALL-E`` pip package (vae.py:14,107-108). Here the graphs are
+re-implemented as NHWC flax modules (channels-last keeps the MXU's 128-lane
+axis on channels) and the published torch checkpoints are ingested by a
+weight converter:
+
+- ``load_torch_checkpoint`` reads a torch pickle *without* needing the
+  original ``dall_e`` classes — a tolerant unpickler substitutes stand-ins
+  for unimportable classes and the parameter tree is walked out of the
+  reconstructed module graph;
+- ``convert_openai_encoder`` / ``convert_openai_decoder`` map the torch
+  state-dict names/layouts onto the flax param tree (OIHW -> HWIO).
+
+Downloads follow the reference's rank-aware protocol (vae.py:53-94): only
+the process-0 host fetches, everyone else waits for the cached file.
+"""
+
+from __future__ import annotations
+
+import io
+import math
+import os
+import pickle
+import time
+import types
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.linen as nn
+
+Dtype = Any
+
+OPENAI_VAE_ENCODER_URL = "https://cdn.openai.com/dall-e/encoder.pkl"
+OPENAI_VAE_DECODER_URL = "https://cdn.openai.com/dall-e/decoder.pkl"
+
+LOGIT_LAPLACE_EPS = 0.1
+
+
+def map_pixels(x: jnp.ndarray) -> jnp.ndarray:
+    """[0, 1] -> logit-laplace domain (reference vae.py:47-48)."""
+    return (1 - 2 * LOGIT_LAPLACE_EPS) * x + LOGIT_LAPLACE_EPS
+
+
+def unmap_pixels(x: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of map_pixels, clamped to [0, 1] (reference vae.py:50-51)."""
+    return jnp.clip((x - LOGIT_LAPLACE_EPS) / (1 - 2 * LOGIT_LAPLACE_EPS), 0, 1)
+
+
+# ---------------------------------------------------------------- flax graphs
+
+
+class OAIConv(nn.Module):
+    """The dVAE's conv: square kernel, (kw-1)//2 same-padding, params named
+    ``w`` (HWIO here; the torch original stores OIHW) and ``b``."""
+
+    n_out: int
+    kw: int
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        n_in = x.shape[-1]
+        w = self.param(
+            "w",
+            nn.initializers.normal(stddev=1 / math.sqrt(n_in * self.kw**2)),
+            (self.kw, self.kw, n_in, self.n_out),
+            self.param_dtype,
+        )
+        b = self.param("b", nn.initializers.zeros, (self.n_out,), self.param_dtype)
+        pad = (self.kw - 1) // 2
+        out = jax.lax.conv_general_dilated(
+            x.astype(self.dtype),
+            w.astype(self.dtype),
+            window_strides=(1, 1),
+            padding=[(pad, pad), (pad, pad)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return out + b.astype(out.dtype)
+
+
+class OAIEncoderBlock(nn.Module):
+    """Bottleneck residual block: id path (1x1 conv on channel change) +
+    post_gain * (relu-conv3, relu-conv3, relu-conv3, relu-conv1)."""
+
+    n_out: int
+    n_layers: int
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        n_hid = self.n_out // 4
+        post_gain = 1 / self.n_layers**2
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+
+        identity = (
+            x
+            if x.shape[-1] == self.n_out
+            else OAIConv(self.n_out, 1, name="id_path", **kw)(x)
+        )
+        h = OAIConv(n_hid, 3, name="res_conv_1", **kw)(nn.relu(x))
+        h = OAIConv(n_hid, 3, name="res_conv_2", **kw)(nn.relu(h))
+        h = OAIConv(n_hid, 3, name="res_conv_3", **kw)(nn.relu(h))
+        h = OAIConv(self.n_out, 1, name="res_conv_4", **kw)(nn.relu(h))
+        return identity + post_gain * h
+
+
+class OAIDecoderBlock(nn.Module):
+    """Mirror of the encoder block: (relu-conv1, relu-conv3, relu-conv3,
+    relu-conv3) residual path."""
+
+    n_out: int
+    n_layers: int
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        n_hid = self.n_out // 4
+        post_gain = 1 / self.n_layers**2
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+
+        identity = (
+            x
+            if x.shape[-1] == self.n_out
+            else OAIConv(self.n_out, 1, name="id_path", **kw)(x)
+        )
+        h = OAIConv(n_hid, 1, name="res_conv_1", **kw)(nn.relu(x))
+        h = OAIConv(n_hid, 3, name="res_conv_2", **kw)(nn.relu(h))
+        h = OAIConv(n_hid, 3, name="res_conv_3", **kw)(nn.relu(h))
+        h = OAIConv(self.n_out, 3, name="res_conv_4", **kw)(nn.relu(h))
+        return identity + post_gain * h
+
+
+class OpenAIEncoder(nn.Module):
+    """4 groups x n_blk_per_group bottleneck blocks with 2x2 maxpool between
+    groups (3 pools -> f=8 downsample), 7x7 input conv, relu + 1x1 conv to
+    vocab logits."""
+
+    group_count: int = 4
+    n_hid: int = 256
+    n_blk_per_group: int = 2
+    vocab_size: int = 8192
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        """x: (b, h, w, 3) in the map_pixels domain -> (b, f, f, vocab)."""
+        n_layers = self.group_count * self.n_blk_per_group
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+
+        x = OAIConv(self.n_hid, 7, name="input", **kw)(x)
+        for g, mult in enumerate((1, 2, 4, 8), start=1):
+            for i in range(self.n_blk_per_group):
+                x = OAIEncoderBlock(
+                    mult * self.n_hid, n_layers, name=f"group_{g}_block_{i + 1}", **kw
+                )(x)
+            if g < self.group_count:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        return OAIConv(self.vocab_size, 1, name="output_conv", **kw)(x)
+
+
+class OpenAIDecoder(nn.Module):
+    """Inverse: 1x1 input conv from one-hot, 4 groups with nearest 2x
+    upsample between (3 upsamples), relu + 1x1 conv to 2*3 output stats."""
+
+    group_count: int = 4
+    n_init: int = 128
+    n_hid: int = 256
+    n_blk_per_group: int = 2
+    output_channels: int = 3
+    vocab_size: int = 8192
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z):
+        """z: (b, f, f, vocab) one-hot -> (b, 8f, 8f, 2*output_channels)."""
+        n_layers = self.group_count * self.n_blk_per_group
+        kw = dict(dtype=self.dtype, param_dtype=self.param_dtype)
+
+        x = OAIConv(self.n_init, 1, name="input", **kw)(z)
+        for g, mult in enumerate((8, 4, 2, 1), start=1):
+            for i in range(self.n_blk_per_group):
+                x = OAIDecoderBlock(
+                    mult * self.n_hid, n_layers, name=f"group_{g}_block_{i + 1}", **kw
+                )(x)
+            if g < self.group_count:
+                x = jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)
+        x = nn.relu(x)
+        return OAIConv(2 * self.output_channels, 1, name="output_conv", **kw)(x)
+
+
+class OpenAIDiscreteVAE(nn.Module):
+    """Frozen pretrained dVAE with the DiscreteVAE duck-type surface
+    (``get_codebook_indices`` / ``decode`` / ``fmap_size`` /
+    ``image_seq_len`` / ``num_tokens``), reference vae.py:103-133.
+
+    ``decode`` returns display-space [0, 1] pixels (``normalization`` is
+    None), unlike the trainable DiscreteVAE whose decoder emits normalized
+    space.
+    """
+
+    image_size: int = 256
+    num_layers: int = 3
+    num_tokens: int = 8192
+    n_hid: int = 256
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    normalization = None  # decode output is already [0, 1]
+
+    @property
+    def fmap_size(self) -> int:
+        return self.image_size // (2**self.num_layers)
+
+    @property
+    def image_seq_len(self) -> int:
+        return self.fmap_size**2
+
+    def setup(self):
+        kw = dict(
+            n_hid=self.n_hid,
+            vocab_size=self.num_tokens,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        self.enc = OpenAIEncoder(**kw)
+        self.dec = OpenAIDecoder(**kw)
+
+    def get_codebook_indices(self, img: jnp.ndarray) -> jnp.ndarray:
+        """img: (b, h, w, 3) in [0, 1] -> (b, f*f) int32 token ids
+        (reference vae.py:115-120)."""
+        logits = self.enc(map_pixels(img))
+        b = logits.shape[0]
+        return jnp.argmax(logits, axis=-1).reshape(b, -1).astype(jnp.int32)
+
+    def decode(self, img_seq: jnp.ndarray) -> jnp.ndarray:
+        """Token ids (b, n) -> (b, H, W, 3) pixels in [0, 1]
+        (reference vae.py:122-130)."""
+        b, n = img_seq.shape
+        f = int(math.isqrt(n))
+        z = jax.nn.one_hot(img_seq, self.num_tokens, dtype=self.dtype)
+        x_stats = self.dec(z.reshape(b, f, f, self.num_tokens)).astype(jnp.float32)
+        return unmap_pixels(jax.nn.sigmoid(x_stats[..., : 3]))
+
+    def __call__(self, img):
+        raise NotImplementedError(
+            "OpenAIDiscreteVAE is frozen and inference-only "
+            "(reference vae.py:132-133)"
+        )
+
+
+# ------------------------------------------------------- torch-pickle ingest
+
+
+class _StandIn:
+    """Stand-in for classes the unpickler can't import (e.g. dall_e.*):
+    accepts any construction protocol and keeps the pickled state."""
+
+    def __init__(self, *args, **kwargs):
+        pass
+
+    def __setstate__(self, state):
+        if isinstance(state, dict):
+            self.__dict__.update(state)
+        else:
+            self.__dict__["_pickled_state"] = state
+
+
+class _TolerantUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        try:
+            return super().find_class(module, name)
+        except (ImportError, AttributeError):
+            return type(name, (_StandIn,), {"__module__": module})
+
+
+def _walk_module_tree(obj, prefix="") -> Dict[str, np.ndarray]:
+    """Extract a flat {dotted_name: ndarray} state dict from a (possibly
+    stand-in) unpickled nn.Module graph."""
+    out: Dict[str, np.ndarray] = {}
+    d = getattr(obj, "__dict__", None) or {}
+    for coll in ("_parameters", "_buffers"):
+        for k, v in (d.get(coll) or {}).items():
+            if v is not None:
+                out[prefix + k] = np.asarray(v.detach().cpu().numpy())
+    for k, v in (d.get("_modules") or {}).items():
+        if v is not None:
+            out.update(_walk_module_tree(v, prefix + k + "."))
+    return out
+
+
+def load_torch_checkpoint(path: str) -> Dict[str, np.ndarray]:
+    """Torch pickle -> flat numpy state dict. Handles plain state-dict
+    pickles and full-module pickles whose defining package (dall_e, taming)
+    is not installed."""
+    import torch
+
+    shim = types.ModuleType("tolerant_pickle")
+    shim.Unpickler = _TolerantUnpickler
+    shim.load = lambda f, **kw: _TolerantUnpickler(f).load()
+    shim.loads = lambda b, **kw: _TolerantUnpickler(io.BytesIO(b)).load()
+    shim.dump = pickle.dump
+    shim.dumps = pickle.dumps
+    shim.HIGHEST_PROTOCOL = pickle.HIGHEST_PROTOCOL
+    obj = torch.load(
+        path, map_location="cpu", pickle_module=shim, weights_only=False
+    )
+    if isinstance(obj, dict):
+        # plain state dict (possibly nested under a conventional key)
+        for key in ("state_dict", "model", "sd"):
+            if key in obj and isinstance(obj[key], dict):
+                obj = obj[key]
+                break
+        return {
+            k: np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+            for k, v in obj.items()
+            if hasattr(v, "detach") or isinstance(v, np.ndarray)
+        }
+    return _walk_module_tree(obj)
+
+
+def _conv_to_hwio(w: np.ndarray) -> np.ndarray:
+    """torch OIHW conv weight -> flax HWIO."""
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def _convert_openai(sd: Dict[str, np.ndarray], kind: str) -> Dict[str, Any]:
+    """Flat torch state dict (keys like ``blocks.group_1.block_2.res_path.
+    conv_3.w``) -> the flax param tree of OpenAIEncoder/OpenAIDecoder."""
+    params: Dict[str, Any] = {}
+
+    def put(path: tuple, leaf: str, value: np.ndarray):
+        node = params
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node.setdefault(path[-1], {})[leaf] = jnp.asarray(value)
+
+    for key, value in sd.items():
+        parts = key.split(".")
+        if parts[0] == "blocks":
+            parts = parts[1:]
+        leaf = parts[-1]
+        if leaf not in ("w", "b"):
+            continue
+        value = _conv_to_hwio(value) if leaf == "w" and value.ndim == 4 else value
+        if parts[0] == "input":
+            put(("input",), leaf, value)
+        elif parts[0] == "output":
+            put(("output_conv",), leaf, value)
+        elif parts[0].startswith("group_"):
+            mod = f"{parts[0]}_{parts[1]}"  # group_g_block_i
+            if parts[2] == "id_path":
+                put((mod, "id_path"), leaf, value)
+            elif parts[2] == "res_path":
+                put((mod, f"res_{parts[3]}"), leaf, value)  # res_conv_i
+            else:
+                raise ValueError(f"unrecognized {kind} key: {key}")
+        else:
+            raise ValueError(f"unrecognized {kind} key: {key}")
+    return params
+
+
+def convert_openai_encoder(sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    return _convert_openai(sd, "encoder")
+
+
+def convert_openai_decoder(sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    return _convert_openai(sd, "decoder")
+
+
+# ----------------------------------------------------------------- download
+
+
+def cache_dir() -> Path:
+    return Path(
+        os.environ.get("DALLE_TPU_CACHE", Path.home() / ".cache" / "dalle_tpu")
+    )
+
+
+def download(url: str, root: Optional[Path] = None, timeout: int = 600) -> Path:
+    """Cached download with the reference's *per-host* coordination semantics
+    (vae.py:53-94: the local-root rank fetches, same-host ranks wait). JAX
+    runs one process per host, and caches are host-local disks, so every
+    process fetches its own copy; concurrent same-host processes are safe
+    because writes go through a pid-unique temp file + atomic rename, and
+    late arrivals see the finished file and skip."""
+    root = Path(root) if root is not None else cache_dir()
+    root.mkdir(parents=True, exist_ok=True)
+    target = root / url.split("/")[-1]
+    if target.exists():
+        return target
+
+    import urllib.request
+
+    tmp = target.with_suffix(f".tmp.{os.getpid()}")
+    with urllib.request.urlopen(url, timeout=timeout) as r, open(tmp, "wb") as f:
+        while True:
+            chunk = r.read(1 << 20)
+            if not chunk:
+                break
+            f.write(chunk)
+    tmp.rename(target)
+    return target
+
+
+def load_openai_vae(
+    enc_path: Optional[str] = None,
+    dec_path: Optional[str] = None,
+    dtype: Dtype = jnp.float32,
+):
+    """(OpenAIDiscreteVAE, params): download (or take local paths to) the
+    published encoder/decoder pickles and convert them. The wrapper's param
+    tree nests them under 'enc' / 'dec'."""
+    enc_path = enc_path or str(download(OPENAI_VAE_ENCODER_URL))
+    dec_path = dec_path or str(download(OPENAI_VAE_DECODER_URL))
+    params = {
+        "enc": convert_openai_encoder(load_torch_checkpoint(enc_path)),
+        "dec": convert_openai_decoder(load_torch_checkpoint(dec_path)),
+    }
+    return OpenAIDiscreteVAE(dtype=dtype), params

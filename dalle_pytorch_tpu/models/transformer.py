@@ -72,6 +72,7 @@ class Transformer(nn.Module):
     rotary_emb: bool = True
     remat: bool = False
     sparse_layout_seed: int = 0
+    use_flash: bool = True
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -122,6 +123,7 @@ class Transformer(nn.Module):
                     stable=self.stable,
                     image_fmap_size=self.image_fmap_size,
                     layout_seed=self.sparse_layout_seed + ind,
+                    use_flash=self.use_flash,
                     dtype=self.dtype,
                     param_dtype=self.param_dtype,
                 )
@@ -139,7 +141,7 @@ class Transformer(nn.Module):
                     fn=attn,
                     image_size=self.image_fmap_size,
                     seq_len=self.seq_len,
-                    pass_decode=attn_type != "mlp",
+                    pass_decode=True,
                 )
                 ff = PreShiftToken(
                     fn=ff, image_size=self.image_fmap_size, seq_len=self.seq_len
@@ -174,11 +176,9 @@ class Transformer(nn.Module):
     def _block_kwargs(self, ind: int, mask, rot, deterministic, decode):
         """(attn kwargs, ff kwargs) for layer ``ind`` in module-call form."""
         kind = self.layer_kinds[ind]
-        akw: dict = dict(deterministic=deterministic)
+        akw: dict = dict(deterministic=deterministic, decode=decode)
         if kind != "mlp":
-            akw.update(mask=mask, rotary_pos_emb=rot, decode=decode)
-        elif self.shift_tokens:
-            akw.update(decode=decode)
+            akw.update(mask=mask, rotary_pos_emb=rot)
         fkw: dict = dict(deterministic=deterministic)
         if self.shift_tokens:
             fkw.update(decode=decode)

@@ -60,6 +60,22 @@ def smooth_l1_loss(pred: jnp.ndarray, target: jnp.ndarray, beta: float = 1.0) ->
     return loss.mean()
 
 
+def denormalize(images, normalization=((0.5,) * 3, (0.5,) * 3)):
+    """Invert ``DiscreteVAE.norm`` for display/save: the decoder emits pixels
+    in normalized space (trained against ``norm(img)``), so saving them raw
+    crushes the lower half of the range to black. x*std + mean, clipped to
+    [0, 1]. The reference instead min-max stretches at save time via
+    ``save_image(normalize=True)`` / ``make_grid(range=(-1, 1))``.
+    Accepts numpy or jax arrays; returns the same family."""
+    import numpy as np
+
+    images = np.asarray(images)
+    if normalization is not None:
+        means, stds = (np.asarray(t, dtype=images.dtype) for t in normalization)
+        images = images * stds + means
+    return np.clip(images, 0.0, 1.0)
+
+
 class ResBlock(nn.Module):
     """3x3 -> 3x3 -> 1x1 residual conv block (reference dalle_pytorch.py:60-72)."""
 

@@ -225,7 +225,7 @@ class SpatialGatingUnit(nn.Module):
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False):
         n = x.shape[-2]
         res, gate = jnp.split(x, 2, axis=-1)
         gate = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype)(gate)
@@ -241,12 +241,44 @@ class SpatialGatingUnit(nn.Module):
         bias = self.param(
             "spatial_bias", nn.initializers.ones, (self.seq_len,), self.param_dtype
         )
+
+        if decode:
+            return self._decode_gate(x, res, gate, weight, bias)
+
         w = weight[:n, :n]
         if self.causal:
             w = jnp.where(jnp.tril(jnp.ones((n, n), dtype=bool)), w, 0.0)
         gate = jnp.einsum("bnd,mn->bmd", gate, w.astype(x.dtype))
         gate = gate + bias[:n, None].astype(x.dtype)
         return res * gate
+
+    def _decode_gate(self, x, res, gate, weight, bias):
+        """One-token decode: the gate mixes over the full (normalized) gate
+        history, so a cache holds it — without this, a 1-token input would see
+        only w[:1, :1] instead of its history row and sampling with 'mlp'
+        layers would silently produce garbage."""
+        b, n, dh = gate.shape
+        assert n == 1, "decode mode consumes one token at a time"
+        is_init = not self.has_variable("cache", "gate_hist")
+        hist = self.variable(
+            "cache", "gate_hist", jnp.zeros, (b, self.seq_len, dh), gate.dtype
+        )
+        idx_var = self.variable(
+            "cache", "gate_index", lambda: jnp.array(0, jnp.int32)
+        )
+        if is_init:
+            return res * gate
+
+        idx = idx_var.value
+        hist.value = jax.lax.dynamic_update_slice(hist.value, gate, (0, idx, 0))
+        w_row = jax.lax.dynamic_slice(weight, (idx, 0), (1, self.seq_len))
+        if self.causal:
+            cols = jnp.arange(self.seq_len)
+            w_row = jnp.where(cols[None, :] <= idx, w_row, 0.0)
+        out = jnp.einsum("bnd,mn->bmd", hist.value, w_row.astype(x.dtype))
+        out = out + jax.lax.dynamic_slice(bias, (idx,), (1,))[:, None].astype(x.dtype)
+        idx_var.value = idx + 1
+        return res * out
 
 
 class GMLPBlock(nn.Module):
@@ -260,7 +292,7 @@ class GMLPBlock(nn.Module):
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, decode: bool = False):
         x = nn.Dense(self.dim_ff, dtype=self.dtype, param_dtype=self.param_dtype)(x)
         x = nn.gelu(x)
         x = SpatialGatingUnit(
@@ -268,7 +300,7 @@ class GMLPBlock(nn.Module):
             causal=self.causal,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
-        )(x)
+        )(x, decode=decode)
         x = nn.Dense(self.dim, dtype=self.dtype, param_dtype=self.param_dtype)(x)
         return x
 

@@ -44,7 +44,7 @@ def main():
     from dalle_pytorch_tpu.data import ChineseTokenizer, HugTokenizer, SimpleTokenizer
     from dalle_pytorch_tpu.models import generate_image_tokens, generate_texts
     from dalle_pytorch_tpu.models.factory import dalle_from_checkpoint
-    from dalle_pytorch_tpu.models.vae import DiscreteVAE
+    from dalle_pytorch_tpu.models.vae import denormalize
 
     assert Path(args.dalle_path).exists(), f"checkpoint not found at {args.dalle_path}"
     dalle, params, vae, vae_params, meta = dalle_from_checkpoint(args.dalle_path)
@@ -62,7 +62,7 @@ def main():
 
     key = jax.random.key(args.seed)
     decode = jax.jit(
-        lambda seq: vae.apply({"params": vae_params}, seq, method=DiscreteVAE.decode)
+        lambda seq: vae.apply({"params": vae_params}, seq, method="decode")
     )
 
     for text in texts:
@@ -91,10 +91,12 @@ def main():
             images.append(np.asarray(decode(img_seq)))
         images = np.concatenate(images)[: args.num_images]
 
+        images = denormalize(images, getattr(vae, "normalization", None))
+
         sub_dir = outputs_dir / text.replace(" ", "_")[:100]
         sub_dir.mkdir(parents=True, exist_ok=True)
         for i, arr in enumerate(images):
-            Image.fromarray((arr.clip(0, 1) * 255).astype(np.uint8)).save(
+            Image.fromarray((arr * 255).astype(np.uint8)).save(
                 sub_dir / f"{i}.png"
             )
         (sub_dir / "caption.txt").write_text(text)

@@ -162,6 +162,7 @@ class TestDALLE:
             dict(),
             dict(rotary_emb=False),
             dict(attn_types=("conv_like", "axial_col"), stable=True),
+            dict(attn_types=("full", "mlp"), rotary_emb=False),
         ],
     )
     def test_decode_matches_forward(self, kw):

@@ -1,0 +1,247 @@
+"""OpenAIDiscreteVAE wrapper tests: torch-pickle ingestion without the
+source package, weight conversion (OIHW->HWIO), and numerics parity of the
+re-owned flax graphs against a torch-side structural replica of the dVAE
+blocks (reference vae.py:103-133 and the published dall_e package layout)."""
+
+import math
+import sys
+import types
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+import torch.nn.functional as tF  # noqa: E402
+
+from dalle_pytorch_tpu.models.pretrained import (  # noqa: E402
+    OpenAIDecoder,
+    OpenAIDiscreteVAE,
+    OpenAIEncoder,
+    convert_openai_decoder,
+    convert_openai_encoder,
+    load_torch_checkpoint,
+    map_pixels,
+    unmap_pixels,
+)
+
+N_HID, VOCAB, BLKS = 8, 16, 2
+
+
+def _fake_dall_e_classes():
+    """Define torch modules structurally identical to the published dVAE
+    under a throwaway module name, so pickles of them are unloadable without
+    the tolerant unpickler (like real dall_e pickles on a box without
+    dall_e installed)."""
+    mod = types.ModuleType("fake_dall_e")
+
+    class Conv2d(tnn.Module):
+        def __init__(self, n_in, n_out, kw):
+            super().__init__()
+            self.kw = kw
+            self.w = tnn.Parameter(
+                torch.randn(n_out, n_in, kw, kw) / math.sqrt(n_in * kw**2)
+            )
+            self.b = tnn.Parameter(torch.zeros(n_out))
+
+        def forward(self, x):
+            return tF.conv2d(x, self.w, self.b, padding=(self.kw - 1) // 2)
+
+    class EncoderBlock(tnn.Module):
+        def __init__(self, n_in, n_out, n_layers):
+            super().__init__()
+            n_hid = n_out // 4
+            self.post_gain = 1 / n_layers**2
+            self.id_path = (
+                Conv2d(n_in, n_out, 1) if n_in != n_out else tnn.Identity()
+            )
+            self.res_path = tnn.Sequential(OrderedDict([
+                ("relu_1", tnn.ReLU()), ("conv_1", Conv2d(n_in, n_hid, 3)),
+                ("relu_2", tnn.ReLU()), ("conv_2", Conv2d(n_hid, n_hid, 3)),
+                ("relu_3", tnn.ReLU()), ("conv_3", Conv2d(n_hid, n_hid, 3)),
+                ("relu_4", tnn.ReLU()), ("conv_4", Conv2d(n_hid, n_out, 1)),
+            ]))
+
+        def forward(self, x):
+            return self.id_path(x) + self.post_gain * self.res_path(x)
+
+    class DecoderBlock(tnn.Module):
+        def __init__(self, n_in, n_out, n_layers):
+            super().__init__()
+            n_hid = n_out // 4
+            self.post_gain = 1 / n_layers**2
+            self.id_path = (
+                Conv2d(n_in, n_out, 1) if n_in != n_out else tnn.Identity()
+            )
+            self.res_path = tnn.Sequential(OrderedDict([
+                ("relu_1", tnn.ReLU()), ("conv_1", Conv2d(n_in, n_hid, 1)),
+                ("relu_2", tnn.ReLU()), ("conv_2", Conv2d(n_hid, n_hid, 3)),
+                ("relu_3", tnn.ReLU()), ("conv_3", Conv2d(n_hid, n_hid, 3)),
+                ("relu_4", tnn.ReLU()), ("conv_4", Conv2d(n_hid, n_out, 3)),
+            ]))
+
+        def forward(self, x):
+            return self.id_path(x) + self.post_gain * self.res_path(x)
+
+    class Encoder(tnn.Module):
+        def __init__(self, n_hid=N_HID, vocab=VOCAB, n_blk=BLKS):
+            super().__init__()
+            n_layers = 4 * n_blk
+            groups = []
+            for g, mult in enumerate((1, 2, 4, 8), start=1):
+                prev = mult // 2 if g > 1 else 1
+                blocks = [
+                    (f"block_{i + 1}",
+                     EncoderBlock((prev if i == 0 else mult) * n_hid,
+                                  mult * n_hid, n_layers))
+                    for i in range(n_blk)
+                ]
+                if g < 4:
+                    blocks.append(("pool", tnn.MaxPool2d(kernel_size=2)))
+                groups.append((f"group_{g}", tnn.Sequential(OrderedDict(blocks))))
+            self.blocks = tnn.Sequential(OrderedDict([
+                ("input", Conv2d(3, n_hid, 7)),
+                *groups,
+                ("output", tnn.Sequential(OrderedDict([
+                    ("relu", tnn.ReLU()), ("conv", Conv2d(8 * n_hid, vocab, 1)),
+                ]))),
+            ]))
+
+        def forward(self, x):
+            return self.blocks(x)
+
+    class Decoder(tnn.Module):
+        def __init__(self, n_init=8, n_hid=N_HID, vocab=VOCAB, n_blk=BLKS):
+            super().__init__()
+            n_layers = 4 * n_blk
+            groups = []
+            for g, mult in enumerate((8, 4, 2, 1), start=1):
+                prev = n_init if g == 1 else mult * 2 * n_hid
+                blocks = [
+                    (f"block_{i + 1}",
+                     DecoderBlock(prev if i == 0 else mult * n_hid,
+                                  mult * n_hid, n_layers))
+                    for i in range(n_blk)
+                ]
+                if g < 4:
+                    blocks.append(
+                        ("upsample", tnn.Upsample(scale_factor=2, mode="nearest"))
+                    )
+                groups.append((f"group_{g}", tnn.Sequential(OrderedDict(blocks))))
+            self.blocks = tnn.Sequential(OrderedDict([
+                ("input", Conv2d(vocab, n_init, 1)),
+                *groups,
+                ("output", tnn.Sequential(OrderedDict([
+                    ("relu", tnn.ReLU()), ("conv", Conv2d(n_hid, 6, 1)),
+                ]))),
+            ]))
+
+        def forward(self, x):
+            return self.blocks(x)
+
+    for cls in (Conv2d, EncoderBlock, DecoderBlock, Encoder, Decoder):
+        cls.__module__ = "fake_dall_e"
+        cls.__qualname__ = cls.__name__
+        setattr(mod, cls.__name__, cls)
+    return mod
+
+
+@pytest.fixture()
+def fake_dall_e():
+    mod = _fake_dall_e_classes()
+    sys.modules["fake_dall_e"] = mod
+    yield mod
+    sys.modules.pop("fake_dall_e", None)
+
+
+def _save_and_strip(model, path):
+    """torch.save the full module, then make its classes unimportable."""
+    torch.save(model, path)
+    sys.modules.pop("fake_dall_e", None)
+
+
+def test_encoder_parity_via_pickle(fake_dall_e, tmp_path):
+    torch.manual_seed(0)
+    tenc = fake_dall_e.Encoder().eval()
+    x = torch.rand(2, 3, 16, 16)
+    with torch.no_grad():
+        ref = tenc(x).numpy()  # (b, vocab, f, f)
+
+    p = tmp_path / "encoder.pkl"
+    _save_and_strip(tenc, str(p))
+    sd = load_torch_checkpoint(str(p))
+    assert "blocks.input.w" in sd and "blocks.output.conv.b" in sd
+
+    params = convert_openai_encoder(sd)
+    enc = OpenAIEncoder(n_hid=N_HID, vocab_size=VOCAB, n_blk_per_group=BLKS)
+    out = enc.apply({"params": params}, jnp.asarray(x.numpy().transpose(0, 2, 3, 1)))
+    np.testing.assert_allclose(
+        np.asarray(out), ref.transpose(0, 2, 3, 1), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_decoder_parity_via_pickle(fake_dall_e, tmp_path):
+    torch.manual_seed(1)
+    tdec = fake_dall_e.Decoder().eval()
+    z = torch.zeros(2, VOCAB, 2, 2)
+    z[:, 3] = 1.0
+    with torch.no_grad():
+        ref = tdec(z).numpy()
+
+    p = tmp_path / "decoder.pkl"
+    _save_and_strip(tdec, str(p))
+    params = convert_openai_decoder(load_torch_checkpoint(str(p)))
+    dec = OpenAIDecoder(
+        n_init=8, n_hid=N_HID, vocab_size=VOCAB, n_blk_per_group=BLKS
+    )
+    out = dec.apply({"params": params}, jnp.asarray(z.numpy().transpose(0, 2, 3, 1)))
+    np.testing.assert_allclose(
+        np.asarray(out), ref.transpose(0, 2, 3, 1), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_state_dict_pickle_also_loads(fake_dall_e, tmp_path):
+    tenc = fake_dall_e.Encoder()
+    p = tmp_path / "sd.pt"
+    torch.save({"state_dict": tenc.state_dict()}, str(p))
+    sd = load_torch_checkpoint(str(p))
+    assert "blocks.input.w" in sd
+
+
+def test_wrapper_surface():
+    """DiscreteVAE duck-type: fmap/seq-len props, encode->decode shapes,
+    frozen __call__."""
+    vae = OpenAIDiscreteVAE(image_size=16, num_layers=3, num_tokens=VOCAB, n_hid=N_HID)
+    assert vae.fmap_size == 2 and vae.image_seq_len == 4
+
+    img = jnp.asarray(np.random.RandomState(0).rand(2, 16, 16, 3), jnp.float32)
+    params = {
+        **vae.init(jax.random.key(0), img, method="get_codebook_indices")["params"],
+        **vae.init(
+            jax.random.key(0), jnp.zeros((2, 4), jnp.int32), method="decode"
+        )["params"],
+    }
+    idx = vae.apply({"params": params}, img, method="get_codebook_indices")
+    assert idx.shape == (2, 4) and idx.dtype == jnp.int32
+    assert (np.asarray(idx) >= 0).all() and (np.asarray(idx) < VOCAB).all()
+
+    pix = vae.apply({"params": params}, idx, method="decode")
+    assert pix.shape == (2, 16, 16, 3)
+    arr = np.asarray(pix)
+    assert np.isfinite(arr).all() and arr.min() >= 0 and arr.max() <= 1
+
+    with pytest.raises(NotImplementedError):
+        vae.apply({"params": params}, img)
+
+
+def test_pixel_remap_roundtrip():
+    x = jnp.linspace(0, 1, 11)
+    np.testing.assert_allclose(
+        np.asarray(unmap_pixels(map_pixels(x))), np.asarray(x), atol=1e-6
+    )
+    # eps remap matches reference vae.py:47-51
+    np.testing.assert_allclose(float(map_pixels(jnp.asarray(0.0))), 0.1)
+    np.testing.assert_allclose(float(map_pixels(jnp.asarray(1.0))), 0.9)

@@ -40,6 +40,19 @@ def parse_args():
     parser.add_argument("--chinese", action="store_true")
     parser.add_argument("--hug", action="store_true")
     parser.add_argument("--bpe_path", type=str, default=None)
+    parser.add_argument("--taming", action="store_true",
+                        help="use a pretrained VQGAN (taming) instead of a "
+                             "trained DiscreteVAE; the default f=16 model "
+                             "cuts image seq 1024 -> 256")
+    parser.add_argument("--vqgan_model_path", type=str, default=None,
+                        help="local taming checkpoint (.ckpt); downloads the "
+                             "published f16/1024 model when omitted")
+    parser.add_argument("--vqgan_config_path", type=str, default=None,
+                        help="local taming config yaml")
+    parser.add_argument("--openai_enc_path", type=str, default=None,
+                        help="local OpenAI dVAE encoder.pkl (downloads when omitted)")
+    parser.add_argument("--openai_dec_path", type=str, default=None,
+                        help="local OpenAI dVAE decoder.pkl")
     parser.add_argument("--dalle_output_file_name", type=str, default="dalle")
     parser.add_argument("--fp16", "--bf16", dest="bf16", action="store_true",
                         help="bf16 compute (the TPU-native analog of --fp16/--amp)")
@@ -147,12 +160,24 @@ def main():
         sched_state = meta.get("scheduler_state")
         assert vae is not None, "resume checkpoint carries no VAE"
     else:
-        assert args.vae_path, (
-            "--vae_path (trained DiscreteVAE checkpoint) or --dalle_path is "
-            "required; pretrained OpenAI/VQGAN wrappers land via "
-            "dalle_pytorch_tpu.models.pretrained"
-        )
-        vae, vae_params, _ = vae_from_checkpoint(args.vae_path)
+        # VAE selection mirrors the reference (train_dalle.py:235-307):
+        # --vae_path (self-trained) > --taming (VQGAN) > OpenAI dVAE default
+        if args.vae_path:
+            vae, vae_params, _ = vae_from_checkpoint(args.vae_path)
+        elif args.taming:
+            from dalle_pytorch_tpu.models.vqgan import load_vqgan_vae
+
+            vae, vae_params = load_vqgan_vae(
+                args.vqgan_config_path, args.vqgan_model_path, dtype=dtype
+            )
+        else:
+            from dalle_pytorch_tpu.models.pretrained import load_openai_vae
+
+            if runtime.is_root_worker():
+                print("using OpenAI's pretrained VAE for encoding images to tokens")
+            vae, vae_params = load_openai_vae(
+                args.openai_enc_path, args.openai_dec_path, dtype=dtype
+            )
         dalle = DALLE(
             dim=args.dim,
             depth=args.depth,
@@ -253,7 +278,7 @@ def main():
 
     vae_encode = jax.jit(
         lambda img: vae.apply(
-            {"params": vae_params}, img, method=DiscreteVAE.get_codebook_indices
+            {"params": vae_params}, img, method="get_codebook_indices"
         ),
         out_shardings=runtime.data_sharding,
     )
@@ -308,6 +333,7 @@ def main():
 
     throughput = Throughput(window=10)
     global_step = 0
+    prev_loss = None
     for epoch in range(start_epoch, args.epochs):
         for i, batch in enumerate(loader):
             image_tokens = vae_encode(batch["image"])
@@ -319,13 +345,20 @@ def main():
                 state, train_batch, jax.random.key(global_step), jnp.asarray(lr)
             )
 
+            # plateau scheduler steps every iteration, like the reference's
+            # sched.step(avg_loss) (train_dalle.py:628-633) — but on the
+            # PREVIOUS step's loss, which has already materialized, so the
+            # host never blocks on the just-dispatched step (a same-step
+            # float(loss) would serialize host and device every iteration)
+            if prev_loss is not None:
+                lr = sched.step(float(prev_loss))
+            prev_loss = loss
+
             if global_step % 10 == 0:
-                loss_v = float(loss)
                 logger.log(
-                    {"loss": loss_v, "epoch": epoch, "iter": i, "lr": lr},
+                    {"loss": float(loss), "epoch": epoch, "iter": i, "lr": lr},
                     step=global_step,
                 )
-                lr = sched.step(loss_v)
             rate = throughput.update(args.batch_size)
             if rate is not None:
                 logger.log({"sample_per_sec": rate}, step=global_step)
@@ -344,11 +377,14 @@ def main():
                 if runtime.is_root_worker():
                     from PIL import Image
 
+                    from dalle_pytorch_tpu.models.vae import denormalize
+
                     out = Path("dalle_samples")
                     out.mkdir(exist_ok=True)
-                    arr = (np.asarray(images[0]).clip(0, 1) * 255).astype(np.uint8)
+                    pix = denormalize(images, getattr(vae, "normalization", None))
+                    arr = (pix[0] * 255).astype(np.uint8)
                     Image.fromarray(arr).save(out / f"sample_{global_step:07d}.png")
-                    logger.log_images("samples", np.asarray(images), step=global_step)
+                    logger.log_images("samples", pix, step=global_step)
 
             global_step += 1
 

@@ -162,9 +162,13 @@ def main():
                 logs["codebook_used"] = int(np.unique(idx).size)
 
                 if runtime.is_root_worker():
+                    from dalle_pytorch_tpu.models.vae import denormalize
+
                     k = min(args.num_images_save, batch["image"].shape[0])
                     samples_dir.mkdir(parents=True, exist_ok=True)
-                    rec = np.asarray(recons[:k]).clip(0, 1)
+                    # recons are in the decoder's normalized space; originals
+                    # are raw [0,1] — bring both to display space
+                    rec = denormalize(recons[:k], vae.normalization)
                     orig = np.asarray(batch["image"][:k])
                     grid = np.concatenate(
                         [np.concatenate(list(orig), 1), np.concatenate(list(rec), 1)], 0
