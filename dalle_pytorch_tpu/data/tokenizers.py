@@ -7,11 +7,11 @@ behind one duck-type: ``encode(text) -> [int]``, ``decode(ids, pad_tokens=...)
 truncate contract (tokenizer.py:137-152). Outputs are host numpy — the device
 boundary is crossed once per batch by the loader, not per sample.
 
-``SimpleTokenizer`` is a from-scratch byte-level BPE (the CLIP scheme: byte ->
+``SimpleTokenizer`` follows OpenAI's MIT-licensed CLIP byte-level BPE (byte ->
 unicode remap, end-of-word ``</w>`` marker, rank-greedy merge loop) over the
-standard ``bpe_simple_vocab_16e6.txt`` merges file (vocab 49408). The merges
-file is *data*, not code; it is resolved at runtime (env var, package data,
-cache, or an existing dalle-pytorch checkout) rather than vendored.
+standard ``bpe_simple_vocab_16e6.txt`` merges file (vocab 49408), which is
+vendored as package data (like the reference's MANIFEST.in) with env-var and
+cache-dir overrides.
 
 ftfy is optional (reference hard-requires it, tokenizer.py:4): when absent,
 a NFC-normalization fallback keeps behavior sane on clean corpora.

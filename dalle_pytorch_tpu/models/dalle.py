@@ -148,8 +148,11 @@ class DALLE(nn.Module):
             param_dtype=self.param_dtype,
         )
         self.final_norm = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype)
+        # the vocab projection runs in compute dtype — in f32 this one matmul
+        # (n x dim x ~18k vocab) would cost more MXU time than a whole layer;
+        # the loss upcasts the logits to f32 before log_softmax
         self.to_logits = nn.Dense(
-            self.total_tokens, dtype=jnp.float32, param_dtype=self.param_dtype
+            self.total_tokens, dtype=self.dtype, param_dtype=self.param_dtype
         )
 
     # ------------------------------------------------------------- helpers
@@ -176,7 +179,7 @@ class DALLE(nn.Module):
     def _head(self, out: jnp.ndarray) -> jnp.ndarray:
         if self.stable:
             out = divide_max(out)
-        return self.to_logits(self.final_norm(out))
+        return self.to_logits(self.final_norm(out)).astype(jnp.float32)
 
     # ------------------------------------------------------------- forward
 

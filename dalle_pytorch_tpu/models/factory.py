@@ -151,7 +151,14 @@ def save_dalle_checkpoint(
     if vae is not None:
         meta["vae_class"] = type(vae).__name__
         meta["vae_config"] = _config_dict(vae)
-        state["vae_params"] = vae_params
+        if isinstance(vae, DiscreteVAE):
+            state["vae_params"] = vae_params
+        # frozen pretrained wrappers (OpenAI dVAE / VQGAN) are NOT bundled:
+        # their weights are immutable public downloads, and re-serializing
+        # ~100s of MB into every periodic checkpoint would dominate save
+        # latency — the loader reconstitutes them from the weight cache
+        # (reference does the same: generate.py:86-91 re-instantiates by
+        # class and the weights come from ~/.cache)
     if opt_state is not None:
         state["opt_state"] = opt_state
         meta["has_opt_state"] = True
@@ -172,9 +179,14 @@ def restore_opt_state(path: str, target: Any) -> Optional[Any]:
     return serialization.from_state_dict(target, state["opt_state"])
 
 
-def dalle_from_checkpoint(path: str):
+def dalle_from_checkpoint(path: str, vae_weight_paths: Optional[dict] = None):
     """-> (dalle, params, vae, vae_params, meta); vae is None when the
-    checkpoint carries no VAE."""
+    checkpoint carries no VAE.
+
+    Frozen pretrained VAEs (OpenAI dVAE / VQGAN) are stored by class+config
+    only; their weights are reconstituted from the download cache, or from
+    local files given in ``vae_weight_paths`` (keys: ``openai_enc_path``,
+    ``openai_dec_path``, ``vqgan_config_path``, ``vqgan_model_path``)."""
     import jax
     from flax import serialization
 
@@ -190,11 +202,28 @@ def dalle_from_checkpoint(path: str):
     params = serialization.from_state_dict(params, state["params"])
 
     vae = vae_params = None
+    wp = vae_weight_paths or {}
     if "vae_config" in meta:
-        cls = vae_classes().get(meta.get("vae_class"))
-        assert cls is not None, f"unknown VAE class {meta.get('vae_class')}"
+        vae_class = meta.get("vae_class")
+        cls = vae_classes().get(vae_class)
+        assert cls is not None, f"unknown VAE class {vae_class}"
         vae = cls(**_restore_dtypes(meta["vae_config"]))
-        vae_params = serialization.from_state_dict(
-            init_vae_params(vae), state["vae_params"]
-        )
+        if "vae_params" in state:
+            vae_params = serialization.from_state_dict(
+                init_vae_params(vae), state["vae_params"]
+            )
+        elif vae_class == "OpenAIDiscreteVAE":
+            from .pretrained import load_openai_vae
+
+            vae, vae_params = load_openai_vae(
+                wp.get("openai_enc_path"), wp.get("openai_dec_path"),
+                dtype=vae.dtype,
+            )
+        elif vae_class == "VQGanVAE":
+            from .vqgan import load_vqgan_vae
+
+            vae, vae_params = load_vqgan_vae(
+                wp.get("vqgan_config_path"), wp.get("vqgan_model_path"),
+                dtype=vae.dtype,
+            )
     return dalle, params, vae, vae_params, meta

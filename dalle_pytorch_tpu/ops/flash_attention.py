@@ -107,11 +107,13 @@ def _scalar_table(visit: np.ndarray) -> np.ndarray:
 # ------------------------------------------------------------------ kernels
 
 
-def _masked_scores(q, k, mask_ref, visit, row0, col0, bq, bk):
-    """(bq, bk) f32 scores with pattern/causal masking applied."""
+def _masked_scores(q, k, sm_scale, mask_ref, visit, row0, col0, bq, bk):
+    """(bq, bk) f32 scores with pattern/causal masking applied. The QK^T dot
+    runs in the inputs' dtype (bf16 on the MXU fast path) with f32
+    accumulation; the scale is applied on the f32 result."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
+    ) * sm_scale
     if mask_ref is not None:
         return jnp.where(mask_ref[:] > 0, s, NEG_INF)
     rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + row0
@@ -149,9 +151,8 @@ def _fwd_kernel(
 
     @pl.when(visit > 0)
     def _():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
         s = _masked_scores(
-            q, k_ref[0].astype(jnp.float32), mask_ref, visit,
+            q_ref[0], k_ref[0], sm_scale, mask_ref, visit,
             qb * block_q, kb * block_k, block_q, block_k,
         )
         m_prev = m_scr[:, 0:1]
@@ -189,12 +190,10 @@ def _bwd_dq_kernel(
 
     @pl.when(visit > 0)
     def _():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         s = _masked_scores(
-            q, k, mask_ref, visit, qb * block_q, kb * block_k, block_q, block_k
+            q, k, sm_scale, mask_ref, visit,
+            qb * block_q, kb * block_k, block_q, block_k,
         )
         p = _masked_exp(s, _row_vec(lse_ref))
         dp = jax.lax.dot_general(
@@ -202,7 +201,8 @@ def _bwd_dq_kernel(
         )
         ds = p * (dp - _row_vec(delta_ref)) * sm_scale
         dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(kb == nk - 1)
@@ -226,22 +226,20 @@ def _bwd_dkv_kernel(
 
     @pl.when(visit > 0)
     def _():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         s = _masked_scores(
-            q, k, mask_ref, visit, qb * block_q, kb * block_k, block_q, block_k
+            q, k, sm_scale, mask_ref, visit,
+            qb * block_q, kb * block_k, block_q, block_k,
         )
         p = _masked_exp(s, _row_vec(lse_ref))
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - _row_vec(delta_ref))  # (bq, bk)
-        # dk += ds^T @ (q * sm_scale): fold the scale back out of q once
+        ds = (p * (dp - _row_vec(delta_ref)) * sm_scale).astype(q.dtype)
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -283,6 +281,11 @@ def _call(kernel, grid, in_specs, out_specs, out_shape, scratch, scalar, operand
             scratch_shapes=scratch,
         ),
         out_shape=out_shape,
+        # batch*heads and outer blocks are independent; only the innermost
+        # (accumulating) dimension is order-dependent — lets Mosaic pipeline
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(scalar, *operands)
 

@@ -30,6 +30,12 @@ def parse_args():
     parser.add_argument("--gentxt", action="store_true",
                         help="complete the prompt with the model before generating images")
     parser.add_argument("--seed", type=int, default=0)
+    # local weight files for checkpoints trained against a frozen pretrained
+    # VAE (whose weights are not bundled in the DALLE checkpoint)
+    parser.add_argument("--vqgan_model_path", type=str, default=None)
+    parser.add_argument("--vqgan_config_path", type=str, default=None)
+    parser.add_argument("--openai_enc_path", type=str, default=None)
+    parser.add_argument("--openai_dec_path", type=str, default=None)
     return parser.parse_args()
 
 
@@ -47,7 +53,16 @@ def main():
     from dalle_pytorch_tpu.models.vae import denormalize
 
     assert Path(args.dalle_path).exists(), f"checkpoint not found at {args.dalle_path}"
-    dalle, params, vae, vae_params, meta = dalle_from_checkpoint(args.dalle_path)
+    dalle, params, vae, vae_params, meta = dalle_from_checkpoint(
+        args.dalle_path,
+        vae_weight_paths={
+            k: getattr(args, k)
+            for k in (
+                "openai_enc_path", "openai_dec_path",
+                "vqgan_config_path", "vqgan_model_path",
+            )
+        },
+    )
     assert vae is not None, "checkpoint carries no VAE — cannot decode images"
 
     if args.chinese:

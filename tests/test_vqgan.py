@@ -304,3 +304,64 @@ model:
     dd, n_embed, embed_dim, gumbel = _ddconfig_from_yaml(str(y))
     assert dd["ch"] == 128 and n_embed == 1024 and embed_dim == 256
     assert not gumbel
+
+
+def test_dalle_checkpoint_with_frozen_vae_roundtrip(models, tmp_path):
+    """Frozen VAE weights are NOT bundled in DALLE checkpoints; the loader
+    reconstitutes them from local paths (or the download cache)."""
+    from dalle_pytorch_tpu.models import DALLE
+    from dalle_pytorch_tpu.models.factory import (
+        dalle_from_checkpoint,
+        save_dalle_checkpoint,
+    )
+
+    tm, fm, fparams = models
+    # write the taming-style artifacts the loader will ingest
+    ckpt = tmp_path / "last.ckpt"
+    torch.save({"state_dict": tm.state_dict()}, str(ckpt))
+    cfg_yaml = tmp_path / "model.yaml"
+    cfg_yaml.write_text(
+        f"""
+model:
+  target: taming.models.vqgan.VQModel
+  params:
+    embed_dim: {CFG['embed_dim']}
+    n_embed: {CFG['n_embed']}
+    ddconfig:
+      z_channels: {CFG['z_channels']}
+      resolution: {CFG['image_size']}
+      ch: {CFG['ch']}
+      ch_mult: {list(CFG['ch_mult'])}
+      num_res_blocks: {CFG['num_res_blocks']}
+      attn_resolutions: {list(CFG['attn_resolutions'])}
+"""
+    )
+
+    dalle = DALLE(
+        dim=32, depth=1, num_text_tokens=32, text_seq_len=4,
+        num_image_tokens=fm.num_tokens, image_fmap_size=fm.fmap_size,
+        heads=2, dim_head=16,
+    )
+    text = jnp.zeros((1, 4), jnp.int32)
+    image = jnp.zeros((1, fm.image_seq_len), jnp.int32)
+    dparams = dalle.init(jax.random.key(0), text, image)["params"]
+
+    path = tmp_path / "dalle.ckpt"
+    save_dalle_checkpoint(str(path), dalle, dparams, vae=fm, vae_params=fparams)
+    # frozen weights must not have been serialized into the checkpoint
+    assert path.stat().st_size < 2_000_000
+
+    dalle2, _, vae2, vae_params2, _ = dalle_from_checkpoint(
+        str(path),
+        vae_weight_paths={
+            "vqgan_config_path": str(cfg_yaml),
+            "vqgan_model_path": str(ckpt),
+        },
+    )
+    assert type(vae2).__name__ == "VQGanVAE"
+    assert vae2.n_embed == CFG["n_embed"]
+    idx = vae2.apply(
+        {"params": vae_params2}, jnp.zeros((1, 16, 16, 3)),
+        method="get_codebook_indices",
+    )
+    assert idx.shape == (1, vae2.image_seq_len)

@@ -155,7 +155,16 @@ def main():
     sched_state = None
     resume_params = None
     if args.dalle_path:
-        dalle, resume_params, vae, vae_params, meta = dalle_from_checkpoint(args.dalle_path)
+        dalle, resume_params, vae, vae_params, meta = dalle_from_checkpoint(
+            args.dalle_path,
+            vae_weight_paths={
+                k: getattr(args, k)
+                for k in (
+                    "openai_enc_path", "openai_dec_path",
+                    "vqgan_config_path", "vqgan_model_path",
+                )
+            },
+        )
         start_epoch = int(meta.get("epoch", -1)) + 1
         sched_state = meta.get("scheduler_state")
         assert vae is not None, "resume checkpoint carries no VAE"
