@@ -51,7 +51,7 @@ def _flash_block(n: int) -> int:
     kernel at small blocks (measured 10x slower at 128 than 640 for seq
     1280), so prefer the biggest multiple-of-128 divisor of n. 128 also
     bounds the lse block's lane dimension (must divide by 128)."""
-    for b in (640, 512, 384, 256, 128):
+    for b in (1280, 1024, 640, 512, 384, 256, 128):
         if n % b == 0:
             return b
     return 0
