@@ -79,6 +79,15 @@ def parse_args():
     train_group.add_argument("--lr_decay", action="store_true")
     train_group.add_argument("--sharded_ckpt", action="store_true",
                              help="also write orbax sharded checkpoints (multi-host scale)")
+    train_group.add_argument("--profile_trace_dir", default=None, type=str,
+                             help="capture a jax.profiler trace (viewable in "
+                                  "TensorBoard/XProf) around --profile_step; "
+                                  "the analog of the reference's DeepSpeed "
+                                  "--flops_profiler (train_dalle.py:473-480)")
+    train_group.add_argument("--profile_step", default=200, type=int,
+                             help="global step at which the trace starts; it "
+                                  "spans 3 steps (the reference profiles step "
+                                  "200)")
 
     model_group = parser.add_argument_group("Model settings")
     model_group.add_argument("--dim", default=512, type=int)
@@ -343,6 +352,7 @@ def main():
     throughput = Throughput(window=10)
     global_step = 0
     prev_loss = None
+    tracing = False
     for epoch in range(start_epoch, args.epochs):
         for i, batch in enumerate(loader):
             image_tokens = vae_encode(batch["image"])
@@ -350,6 +360,23 @@ def main():
                 "text": jnp.asarray(batch["text"]),
                 "image": image_tokens,
             }
+            if args.profile_trace_dir is not None and runtime.is_root_worker():
+                # trace a steady-state window: block so compilation and the
+                # profiled steps don't overlap in the capture
+                if global_step == args.profile_step:
+                    jax.block_until_ready(state.params)
+                    jax.profiler.start_trace(args.profile_trace_dir)
+                    tracing = True
+                elif global_step == args.profile_step + 3:
+                    jax.block_until_ready(state.params)
+                    jax.profiler.stop_trace()
+                    tracing = False
+                    logger.log_text(
+                        f"profiler trace for steps "
+                        f"{args.profile_step}..{args.profile_step + 2} "
+                        f"written to {args.profile_trace_dir}"
+                    )
+
             state, loss = step_fn(
                 state, train_batch, jax.random.key(global_step), jnp.asarray(lr)
             )
@@ -400,6 +427,10 @@ def main():
         save(epoch)
         save_sharded(global_step)
         logger.log_text(f"epoch {epoch} complete")
+
+    if tracing:  # training ended inside the trace window
+        jax.block_until_ready(state.params)
+        jax.profiler.stop_trace()
 
     logger.finish()
 
