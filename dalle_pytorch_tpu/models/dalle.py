@@ -69,6 +69,7 @@ class DALLE(nn.Module):
     remat: bool = False
     sparse_layout_seed: int = 0
     use_flash: bool = True
+    sp_axis: Optional[str] = None
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -144,6 +145,7 @@ class DALLE(nn.Module):
             remat=self.remat,
             sparse_layout_seed=self.sparse_layout_seed,
             use_flash=self.use_flash,
+            sp_axis=self.sp_axis,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )
@@ -215,8 +217,13 @@ class DALLE(nn.Module):
             tokens = tokens[:, : self.total_seq_len]
         n = tokens.shape[1]
 
+        x = tokens.astype(self.dtype)
+        if self.sp_axis is not None and not self.is_initializing():
+            from ..parallel.context import constrain_seq_sharded
+
+            x = constrain_seq_sharded(x, self.sp_axis, seq_dim=1)
         out = self.transformer(
-            tokens.astype(self.dtype),
+            x,
             mask=self._full_key_mask(mask, n),
             deterministic=deterministic,
         )

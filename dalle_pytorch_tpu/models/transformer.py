@@ -73,6 +73,7 @@ class Transformer(nn.Module):
     remat: bool = False
     sparse_layout_seed: int = 0
     use_flash: bool = True
+    sp_axis: Optional[str] = None
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -98,6 +99,12 @@ class Transformer(nn.Module):
                 raise ValueError(f'attention type "{t}" is not valid')
         if self.rotary_emb and "mlp" in attn_types:
             raise ValueError("gMLP layers cannot be combined with rotary embeddings")
+        if self.sp_axis is not None and "mlp" in attn_types:
+            raise ValueError(
+                "gMLP spatial gating mixes the whole sequence locally and "
+                "cannot run sequence-parallel; drop 'mlp' from attn_types "
+                "or disable sp"
+            )
 
         attn_blocks, ff_blocks, kinds = [], [], []
         for ind in range(self.depth):
@@ -124,6 +131,7 @@ class Transformer(nn.Module):
                     image_fmap_size=self.image_fmap_size,
                     layout_seed=self.sparse_layout_seed + ind,
                     use_flash=self.use_flash,
+                    sp_axis=self.sp_axis,
                     dtype=self.dtype,
                     param_dtype=self.param_dtype,
                 )

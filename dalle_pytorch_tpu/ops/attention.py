@@ -106,6 +106,7 @@ class PatternAttention(nn.Module):
     num_random_blocks: Optional[int] = None
     layout_seed: int = 0
     use_flash: bool = True
+    sp_axis: Optional[str] = None
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -168,7 +169,15 @@ class PatternAttention(nn.Module):
                 table = rotary_pos_emb[:n][None, None]  # (1, 1, n, rot)
                 q, k, v = (apply_rotary_emb(table, t) for t in (q, k, v))
 
+            from ..parallel.context import sp_extent
+
             if (
+                not force_dense
+                and not self.is_initializing()
+                and sp_extent(self.sp_axis) > 1
+            ):
+                out = self._sp_attend(q, k, v, mask, n)
+            elif (
                 self.use_flash
                 and not force_dense
                 and mask is None
@@ -177,15 +186,9 @@ class PatternAttention(nn.Module):
             ):
                 out = self._flash_attend(q, k, v, n)
             else:
-                q = q * (d**-0.5)
-                if force_dense:
-                    out = self._dense_attend(q, k, v, mask)
-                elif self.attn_type in ("axial_row", "axial_col"):
-                    out = self._axial_attend(q, k, v, mask)
-                elif self.attn_type == "conv_like":
-                    out = self._conv_attend(q, k, v, mask)
-                else:
-                    out = self._dense_attend(q, k, v, mask)
+                out = self._pattern_attend(
+                    q * (d**-0.5), k, v, mask, force_dense=force_dense
+                )
 
         out = out.transpose(0, 2, 1, 3).reshape(b, -1, inner)
         out = nn.Dense(self.dim, dtype=self.dtype, param_dtype=self.param_dtype, name="to_out")(out)
@@ -210,6 +213,64 @@ class PatternAttention(nn.Module):
             block_k=block,
             interpret=jax.devices()[0].platform != "tpu",
         )
+
+    # -------------------------------------------------- sequence parallelism
+
+    def _sp_attend(self, q, k, v, mask, n: int):
+        """Sequence-parallel attention over the ``sp_axis`` mesh axis
+        (ops/ring_attention.py): ring attention for the dense-causal pattern,
+        Ulysses all-to-all for every other pattern. The surrounding network
+        stays GSPMD-sharded; only this core runs under shard_map. The
+        reference has no sequence parallelism at all (SURVEY.md §5.7)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.context import active_mesh, batch_axes
+        from .ring_attention import ring_attention, ulysses_attend
+
+        mesh = active_mesh()
+        sp = int(mesh.shape[self.sp_axis])
+        assert n % sp == 0, f"seq len {n} not divisible by sp={sp}"
+        d = self.dim_head
+        scale = d**-0.5
+
+        batch = batch_axes(mesh)
+        head = "tp" if "tp" in mesh.axis_names else None
+        qspec = P(batch, head, self.sp_axis, None)
+        mspec = P(batch, self.sp_axis)
+
+        if self.attn_type == "full" and self.causal:
+
+            def body(q, k, v, km=None):
+                return ring_attention(
+                    q, k, v, self.sp_axis, sp,
+                    causal=True, sm_scale=scale, key_mask=km,
+                )
+
+        else:
+
+            def local_fn(q, k, v, km):
+                return self._pattern_attend(q * scale, k, v, km)
+
+            def body(q, k, v, km=None):
+                return ulysses_attend(
+                    q, k, v, self.sp_axis, sp, local_fn, key_mask=km
+                )
+
+        args = (q, k, v) if mask is None else (q, k, v, mask[:, :n])
+        in_specs = (qspec,) * 3 + ((mspec,) if mask is not None else ())
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=qspec,
+            check_vma=False,
+        )(*args)
+
+    def _pattern_attend(self, q, k, v, mask, force_dense: bool = False):
+        """Dispatch to this pattern's FLOP-efficient path (q pre-scaled)."""
+        if not force_dense:
+            if self.attn_type in ("axial_row", "axial_col"):
+                return self._axial_attend(q, k, v, mask)
+            if self.attn_type == "conv_like":
+                return self._conv_attend(q, k, v, mask)
+        return self._dense_attend(q, k, v, mask)
 
     # ------------------------------------------------------------ dense paths
 

@@ -1,0 +1,167 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO sequence parallelism — its long-sequence levers are
+sparse attention patterns and reversible layers (SURVEY.md §5.7); sequence
+length is fixed at text + image_fmap**2 (dalle_pytorch.py:352). On TPU,
+sequence parallelism is a first-class scaling axis: activations are sharded
+over the ``sp`` mesh axis so per-chip activation memory and attention FLOPs
+shrink by the sp extent, with the K/V exchange riding ICI.
+
+Two complementary schemes, both written as *per-shard* bodies to be run under
+``jax.shard_map`` (the surrounding network stays GSPMD/pjit-sharded — only
+attention, whose mixing is global over the sequence, needs manual
+collectives):
+
+- ``ring_attention``: flash-style online-softmax accumulation while K/V
+  chunks rotate around the ring via ``jax.lax.ppermute``. Used for dense
+  causal ("full") layers. Causality is exploited per source chunk: blocks
+  strictly in the future contribute nothing and their matmuls are skipped
+  with ``lax.cond``, so the expected FLOP cost matches causal attention.
+  Each hop's ppermute overlaps with the current chunk's compute (XLA
+  schedules the collective-permute asynchronously on ICI).
+
+- ``ulysses_attend``: two ``jax.lax.all_to_all`` calls re-shard
+  (batch, heads/sp, FULL seq) <-> (batch, heads, seq/sp), running an
+  arbitrary *local* attention pattern (axial / conv-like / block-sparse /
+  non-causal CLIP) in between. This keeps every static pattern mask exactly
+  as defined over the full sequence — no per-pattern communication logic.
+
+Numerics match ``ops.attention.dense_attend``: logits and softmax
+accumulate in float32 regardless of input dtype; fully-masked query rows
+produce exactly 0 (the reference never hits this case; see
+ADVICE round-1 on the flash kernel's contract).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    axis_size: int,
+    causal: bool = True,
+    sm_scale: float = 1.0,
+    key_mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Per-shard ring attention body (run under ``shard_map``).
+
+    q, k, v: (b, h, n_local, d) — this shard's contiguous chunk of the
+    sequence (shard i holds global rows [i*n_local, (i+1)*n_local)).
+    ``key_mask``: optional (b, n_local) bool chunk of a global key-padding
+    mask (True = attend); it rotates around the ring with its k/v chunk.
+    Returns the local (b, h, n_local, d) output chunk.
+    """
+    b, h, nl, d = q.shape
+    my = jax.lax.axis_index(axis_name)
+
+    m = jnp.full((b, h, nl, 1), NEG_INF, jnp.float32)  # running row max
+    l = jnp.zeros((b, h, nl, 1), jnp.float32)  # running row sum
+    acc = jnp.zeros((b, h, nl, d), jnp.float32)  # unnormalized output
+
+    local_causal = jnp.tril(jnp.ones((nl, nl), bool))[None, None]
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def block_update(q, k, v, km, m, l, acc, mask):
+        s = jnp.einsum(
+            "bhid,bhjd->bhij", q, k, preferred_element_type=jnp.float32
+        ) * sm_scale
+        if km is not None:
+            kmask = km[:, None, None, :]
+            mask = kmask if mask is None else mask & kmask
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        # exp(NEG_INF - NEG_INF) would be 1 for masked entries of a row whose
+        # running max is still NEG_INF; force those to exactly 0
+        p = jnp.where(s <= NEG_INF, 0.0, jnp.exp(s - m_new))
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum(
+            "bhij,bhjd->bhid",
+            p.astype(v.dtype),
+            v,
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    # python-unrolled over the (small, static) ring extent; after step s the
+    # local k/v buffer holds the chunk originating from shard (my - s) % size
+    for s in range(axis_size):
+        src = (my - s) % axis_size
+
+        if causal:
+            def visit(args):
+                k, v, km, m, l, acc = args
+                # src < my: fully visible. src == my: local causal triangle.
+                mask = (src < my) | local_causal
+                return block_update(q, k, v, km, m, l, acc, mask)
+
+            def skip(args):
+                k, v, km, m, l, acc = args
+                return m, l, acc
+
+            m, l, acc = jax.lax.cond(
+                src <= my, visit, skip, (k, v, key_mask, m, l, acc)
+            )
+        else:
+            m, l, acc = block_update(q, k, v, key_mask, m, l, acc, None)
+
+        if s != axis_size - 1:
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+            if key_mask is not None:
+                key_mask = jax.lax.ppermute(key_mask, axis_name, perm)
+
+    out = acc / jnp.maximum(l, 1.0e-30)
+    out = jnp.where(l > 0.0, out, 0.0)
+    return out.astype(q.dtype)
+
+
+def ulysses_attend(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    axis_size: int,
+    attend_fn: Callable[..., jnp.ndarray],
+    key_mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Per-shard Ulysses (all-to-all) attention body (run under shard_map).
+
+    q, k, v: (b, h_local, n_local, d). Re-shards to (b, h_local/sp, n, d) so
+    ``attend_fn(q, k, v, key_mask)`` sees the FULL sequence with a head
+    subset, then re-shards the output back to the sequence layout.
+    ``attend_fn`` must be head-elementwise (true for every pattern path in
+    ops/attention.py). ``key_mask``: optional (b, n_local) bool chunk,
+    all-gathered to the full (b, n) mask for the local call.
+    """
+    h_local = q.shape[1]
+    assert h_local % axis_size == 0, (
+        f"local head count {h_local} not divisible by sp={axis_size}; "
+        f"reduce sp or tp so heads/(tp*sp) is integral"
+    )
+
+    def to_heads(t):  # gather seq, scatter heads
+        return jax.lax.all_to_all(
+            t, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    def to_seq(t):  # gather heads, scatter seq
+        return jax.lax.all_to_all(
+            t, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    km = None
+    if key_mask is not None:
+        km = jax.lax.all_gather(key_mask, axis_name, axis=1, tiled=True)
+    out = attend_fn(to_heads(q), to_heads(k), to_heads(v), km)
+    return to_seq(out)

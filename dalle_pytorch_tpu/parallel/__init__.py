@@ -1,3 +1,4 @@
+from .context import activate_mesh, active_mesh
 from .mesh import AXIS_NAMES, MeshRuntime, init_distributed, make_runtime
 from .sharding import (
     DEFAULT_RULES,
@@ -13,6 +14,8 @@ __all__ = [
     "DEFAULT_RULES",
     "MeshRuntime",
     "TrainState",
+    "activate_mesh",
+    "active_mesh",
     "create_train_state",
     "init_distributed",
     "make_eval_step",

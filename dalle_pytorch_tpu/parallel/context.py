@@ -1,0 +1,66 @@
+"""Ambient mesh context for ops that need manual collectives.
+
+GSPMD/pjit sharding is declarative and needs no runtime context, but the
+sequence-parallel attention paths (ops/ring_attention.py) are written as
+``shard_map`` bodies, and ``shard_map`` needs the concrete ``Mesh`` at trace
+time. Model modules must stay construction-time independent of the runtime
+(the reference's hidden global backend singleton, distributed_utils.py:28-31,
+is exactly the coupling SURVEY.md §3.4 says to avoid), so the mesh is passed
+ambiently: the train-step builder / CLI activates it around tracing, and
+``PatternAttention`` picks it up only when its ``sp_axis`` is set.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, Optional
+
+from jax.sharding import Mesh
+
+_STATE = threading.local()
+
+
+def active_mesh() -> Optional[Mesh]:
+    """The mesh activated by the innermost ``activate_mesh`` context, if any."""
+    return getattr(_STATE, "mesh", None)
+
+
+@contextlib.contextmanager
+def activate_mesh(mesh: Mesh) -> Iterator[Mesh]:
+    prev = active_mesh()
+    _STATE.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _STATE.mesh = prev
+
+
+def batch_axes(mesh: Mesh):
+    """The data-parallel axis-name tuple present in ``mesh`` (or None)."""
+    names = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names)
+    return names or None
+
+
+def sp_extent(sp_axis: Optional[str]) -> int:
+    """Extent of the sequence-parallel axis under the active mesh (1 when no
+    mesh is active or the axis is absent/trivial)."""
+    mesh = active_mesh()
+    if sp_axis is None or mesh is None:
+        return 1
+    return int(mesh.shape.get(sp_axis, 1))
+
+
+def constrain_seq_sharded(x, sp_axis: Optional[str], seq_dim: int = 1):
+    """Ask GSPMD to keep activation ``x`` sharded over ``sp_axis`` on its
+    sequence dimension (no-op without an active mesh / trivial sp)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = active_mesh()
+    if sp_axis is None or mesh is None or mesh.shape.get(sp_axis, 1) == 1:
+        return x
+    spec = [None] * x.ndim
+    spec[0] = batch_axes(mesh)
+    spec[seq_dim] = sp_axis
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))

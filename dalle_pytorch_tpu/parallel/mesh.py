@@ -134,6 +134,14 @@ class MeshRuntime:
 
     # -------------------------------------------------------------- specs
 
+    def activate(self):
+        """Context manager exposing this mesh ambiently to ops that build
+        shard_map bodies at trace time (the sequence-parallel attention
+        paths); see parallel/context.py."""
+        from .context import activate_mesh
+
+        return activate_mesh(self.mesh)
+
     def sharding(self, spec: P) -> NamedSharding:
         return NamedSharding(self.mesh, spec)
 
@@ -148,11 +156,16 @@ class MeshRuntime:
         return self.sharding(self.data_spec)
 
     def check_batch_size(self, batch_size: int) -> None:
-        """Global batch must cover the data-parallel extent
-        (distributed_backend.py:56-60)."""
+        """Global batch must cover AND divide over the data-parallel extent
+        (reference only asserts coverage, distributed_backend.py:56-60;
+        sharded jit and the sp shard_map path both need even division)."""
         dp_total = self.mesh.shape.get("dp", 1) * self.mesh.shape.get("fsdp", 1)
         assert batch_size >= dp_total, (
             f"batch size {batch_size} smaller than data-parallel extent {dp_total}"
+        )
+        assert batch_size % dp_total == 0, (
+            f"batch size {batch_size} not divisible by data-parallel extent "
+            f"{dp_total}"
         )
 
 

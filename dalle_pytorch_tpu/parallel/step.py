@@ -113,7 +113,26 @@ def make_train_step(
             return new_state, loss, aux
         return new_state, loss
 
-    return train_step
+    return _with_ambient_mesh(train_step, runtime)
+
+
+def _with_ambient_mesh(jitted, runtime: MeshRuntime):
+    """Wrap a jitted step so calls (and AOT ``lower``) trace with the mesh
+    ambiently active — the sp attention paths build shard_map bodies at trace
+    time and need the concrete mesh (parallel/context.py). No-op once the
+    trace is cached."""
+
+    def with_mesh(*args, **kw):
+        with runtime.activate():
+            return jitted(*args, **kw)
+
+    def lower(*args, **kw):
+        with runtime.activate():
+            return jitted.lower(*args, **kw)
+
+    with_mesh.jitted = jitted
+    with_mesh.lower = lower
+    return with_mesh
 
 
 def make_eval_step(
@@ -131,4 +150,4 @@ def make_eval_step(
     def eval_step(params, batch, rng):
         return loss_fn(params, batch, rng)
 
-    return eval_step
+    return _with_ambient_mesh(eval_step, runtime)

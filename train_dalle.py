@@ -65,6 +65,9 @@ def parse_args():
     mesh_group = parser.add_argument_group("Mesh settings")
     mesh_group.add_argument("--fsdp", type=int, default=1)
     mesh_group.add_argument("--tp", type=int, default=1)
+    mesh_group.add_argument("--sp", type=int, default=1,
+                            help="sequence/context parallel extent (ring + "
+                                 "Ulysses attention over the sp mesh axis)")
 
     train_group = parser.add_argument_group("Training settings")
     train_group.add_argument("--epochs", default=20, type=int)
@@ -154,7 +157,7 @@ def main():
     )
 
     init_distributed()
-    runtime = make_runtime(fsdp=args.fsdp, tp=args.tp)
+    runtime = make_runtime(fsdp=args.fsdp, tp=args.tp, sp=args.sp)
     runtime.check_batch_size(args.batch_size)
     tokenizer = pick_tokenizer(args)
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
@@ -177,6 +180,11 @@ def main():
         start_epoch = int(meta.get("epoch", -1)) + 1
         sched_state = meta.get("scheduler_state")
         assert vae is not None, "resume checkpoint carries no VAE"
+        # sequence parallelism is a runtime layout choice, not a model
+        # hyperparameter: follow this run's --sp, not the checkpoint's
+        want_sp = "sp" if args.sp > 1 else None
+        if dalle.sp_axis != want_sp:
+            dalle = dalle.clone(sp_axis=want_sp)
     else:
         # VAE selection mirrors the reference (train_dalle.py:235-307):
         # --vae_path (self-trained) > --taming (VQGAN) > OpenAI dVAE default
@@ -214,6 +222,7 @@ def main():
             shift_tokens=args.shift_tokens,
             rotary_emb=args.rotary_emb,
             remat=args.remat,
+            sp_axis="sp" if args.sp > 1 else None,
             dtype=dtype,
         )
 
