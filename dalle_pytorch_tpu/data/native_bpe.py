@@ -1,0 +1,113 @@
+"""ctypes binding for the native C++ BPE engine (native/bpe_tokenizer.cc).
+
+``NativeSimpleTokenizer`` is a drop-in for ``SimpleTokenizer`` (same vocab,
+same tokenize/encode/decode contract, byte-exact outputs — parity-tested in
+tests/test_native_bpe.py) with the scanner + merge loop running natively.
+Text cleaning (ftfy/NFC, html unescape, whitespace collapse, lowercase) stays
+in Python so both tokenizers share data/tokenizers.py's exact preprocessing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from .tokenizers import (
+    _TokenizeMixin,
+    basic_clean,
+    default_bpe_path,
+    whitespace_clean,
+)
+
+_lib = None
+_lib_failed = False
+
+
+def _load_lib():
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    from ..native.build import build
+
+    so = build()
+    if so is None:
+        _lib_failed = True
+        return None
+    lib = ctypes.CDLL(str(so))
+    lib.bpe_new.restype = ctypes.c_void_p
+    lib.bpe_new.argtypes = [ctypes.c_char_p]
+    lib.bpe_free.argtypes = [ctypes.c_void_p]
+    lib.bpe_vocab_size.restype = ctypes.c_int32
+    lib.bpe_vocab_size.argtypes = [ctypes.c_void_p]
+    lib.bpe_encode.restype = ctypes.c_int64
+    lib.bpe_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+    ]
+    lib.bpe_decode.restype = ctypes.c_int64
+    lib.bpe_decode.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_int64,
+    ]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load_lib() is not None
+
+
+class NativeSimpleTokenizer(_TokenizeMixin):
+    """CLIP byte-level BPE backed by the C++ engine."""
+
+    def __init__(self, bpe_path: Optional[str] = None):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError(
+                "native BPE engine unavailable (no C++ toolchain?); use "
+                "SimpleTokenizer instead"
+            )
+        bpe_path = bpe_path or default_bpe_path()
+        if bpe_path is None:
+            raise FileNotFoundError("BPE merges file not found")
+        self._lib = lib
+        self._h = lib.bpe_new(bpe_path.encode())
+        if not self._h:
+            raise RuntimeError(f"native BPE engine failed to load {bpe_path}")
+        self.vocab_size = int(lib.bpe_vocab_size(self._h))
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.bpe_free(h)
+            self._h = None
+
+    def encode(self, text: str) -> List[int]:
+        text = whitespace_clean(basic_clean(text)).lower()
+        raw = text.encode("utf-8")
+        cap = max(len(raw) * 2, 64)
+        while True:
+            buf = (ctypes.c_int32 * cap)()
+            n = self._lib.bpe_encode(self._h, raw, len(raw), buf, cap)
+            if n <= cap:
+                return list(buf[:n])
+            cap = int(n)
+
+    def decode(self, tokens: Iterable[int], pad_tokens: set = frozenset()) -> str:
+        ids = np.asarray([int(t) for t in tokens], np.int32)
+        skip = np.asarray(sorted(int(t) for t in pad_tokens), np.int32)
+        ids_p = ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        skip_p = skip.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        cap = max(len(ids) * 16, 64)
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.bpe_decode(
+                self._h, ids_p, len(ids), skip_p, len(skip), buf, cap
+            )
+            if n <= cap:
+                return buf.raw[:n].decode("utf-8", errors="replace")
+            cap = int(n)

@@ -285,13 +285,32 @@ class YttmTokenizer(_TokenizeMixin):
         )[0]
 
 
-_default: Optional[SimpleTokenizer] = None
+_default: Optional[_TokenizeMixin] = None
 
 
-def get_tokenizer() -> SimpleTokenizer:
+def get_tokenizer() -> _TokenizeMixin:
     """Lazily-built module default (the reference builds one at import,
-    tokenizer.py:154; lazy keeps import cheap when the vocab is elsewhere)."""
+    tokenizer.py:154; lazy keeps import cheap when the vocab is elsewhere).
+
+    Prefers the native C++ engine (native/bpe_tokenizer.cc, byte-exact with
+    SimpleTokenizer — tests/test_native_bpe.py); set DALLE_TPU_NO_NATIVE=1 to
+    force the pure-Python implementation."""
     global _default
     if _default is None:
-        _default = SimpleTokenizer()
+        if os.environ.get("DALLE_TPU_NO_NATIVE", "") in ("", "0"):
+            try:
+                from .native_bpe import NativeSimpleTokenizer
+
+                _default = NativeSimpleTokenizer()
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"native BPE engine unavailable ({e!r}); falling back to "
+                    f"the pure-Python tokenizer (slower). Set "
+                    f"DALLE_TPU_NO_NATIVE=1 to silence this."
+                )
+                _default = None
+        if _default is None:
+            _default = SimpleTokenizer()
     return _default

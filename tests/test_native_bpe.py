@@ -1,0 +1,128 @@
+"""Parity tests: native C++ BPE engine vs the Python SimpleTokenizer.
+
+The native engine (native/bpe_tokenizer.cc) re-owns the reference's native
+tokenizer dependencies (HF tokenizers / youtokentome, SURVEY.md §2.3) and
+must be byte-exact with the Python implementation on every input: same ids,
+same decode, same tokenize() contract.
+"""
+
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.data.native_bpe import (
+    NativeSimpleTokenizer,
+    native_available,
+)
+from dalle_pytorch_tpu.data.tokenizers import SimpleTokenizer
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain for the native engine"
+)
+
+CORPUS = [
+    "a red square",
+    "A man riding a horse on the beach at sunset.",
+    "Hello, World! It's a test... isn't it?",
+    "naïve café — résumé über straße",
+    "numbers 0 1 23 456 7890 and ² ³ ½ Ⅳ",
+    "emoji 🎨🌈🦄 and CJK 中文字符串 and kana テスト ひらがな",
+    "<|startoftext|>prompt<|endoftext|>",
+    "mixed<|endoftext|>inline special",
+    "don't can't we'll I'm you've they're he'd 'quoted'",
+    "  collapse   whitespace\tand\nnewlines\r\nplease ",
+    "punctuation!!! ??? ... ---- ###$$$%%%",
+    "!!<|startoftext|>not-special-mid-punct-run",
+    "price: $12.50 (50% off!) e.g. i.e. etc.",
+    "html &amp; entities &lt;tag&gt;",
+    "Ωμέγα ελληνικά кириллица العربية עברית हिन्दी",
+    "snake_case camelCase SCREAMING dots.and.dots",
+    "a" * 300,
+    "ab " * 100,
+    "",
+    "   ",
+    "'", "''", "'s", "x's", "'sx", "'ll", "o'clock",
+    # regression pins for regex-IGNORECASE case-closure quirks:
+    "'ſ",    # long s: matches the 's contraction under IGNORECASE
+    "ͅ",     # combining ypogegrammeni: matches NO alternative, skipped
+    "aͅb", "it'ſ done",
+]
+
+
+@pytest.fixture(scope="module")
+def pair():
+    return NativeSimpleTokenizer(), SimpleTokenizer()
+
+
+def test_vocab_size(pair):
+    nt, pt = pair
+    assert nt.vocab_size == pt.vocab_size == 49408
+
+
+@pytest.mark.parametrize("text", CORPUS, ids=range(len(CORPUS)))
+def test_encode_parity(pair, text):
+    nt, pt = pair
+    assert nt.encode(text) == pt.encode(text)
+
+
+def test_decode_parity_and_roundtrip(pair):
+    nt, pt = pair
+    for text in CORPUS:
+        ids = pt.encode(text)
+        assert nt.decode(ids) == pt.decode(ids)
+
+
+def test_decode_skips_pads(pair):
+    nt, pt = pair
+    ids = pt.encode("a blue circle")
+    padded = [0] + ids[:2] + [49152, 49200] + ids[2:] + [0, 0]
+    pads = {49152, 49200}
+    assert nt.decode(padded, pad_tokens=pads) == pt.decode(padded, pad_tokens=pads)
+
+
+def test_randomized_fuzz_parity(pair):
+    """Random unicode strings: the scanner and merge loop must agree
+    everywhere, not just on curated samples."""
+    nt, pt = pair
+    rng = np.random.RandomState(0)
+    pools = [
+        list(range(0x20, 0x7F)),                  # ascii
+        list(range(0xA0, 0x250)),                 # latin supplement/extended
+        list(range(0x370, 0x400)),                # greek
+        list(range(0x4E00, 0x4E80)),              # CJK
+        [0x1F600 + i for i in range(40)],         # emoji
+        [0x20, 0x27, 0x2E, 0x31, 0x32],           # space/quote/dot/digits
+        [0x27, 0x73, 0x17F, 0x345, 0x6C, 0x74],   # contraction/case-fold traps
+        list(range(0x00, 0x20)),                  # control chars
+        list(range(0x2000, 0x2030)),              # unicode spaces/format chars
+    ]
+    for _ in range(200):
+        n = rng.randint(1, 60)
+        cps = [
+            int(rng.choice(pools[rng.randint(len(pools))])) for _ in range(n)
+        ]
+        text = "".join(chr(c) for c in cps)
+        assert nt.encode(text) == pt.encode(text), repr(text)
+
+
+def test_tokenize_contract(pair):
+    nt, _ = pair
+    out = nt.tokenize(["a red square", "tiny"], context_length=16)
+    assert out.shape == (2, 16) and out.dtype == np.int32
+    assert out[1, -1] == 0  # zero padded
+    with pytest.raises(RuntimeError):
+        nt.tokenize(["word " * 200], context_length=8)
+    trunc = nt.tokenize(["word " * 200], context_length=8, truncate_text=True)
+    assert trunc.shape == (1, 8)
+
+
+def test_get_tokenizer_prefers_native(monkeypatch):
+    import dalle_pytorch_tpu.data.tokenizers as tok
+
+    monkeypatch.setattr(tok, "_default", None)
+    t = tok.get_tokenizer()
+    assert isinstance(t, NativeSimpleTokenizer)
+    monkeypatch.setattr(tok, "_default", None)
+    monkeypatch.setenv("DALLE_TPU_NO_NATIVE", "1")
+    t = tok.get_tokenizer()
+    assert isinstance(t, SimpleTokenizer)
+    monkeypatch.setattr(tok, "_default", None)
