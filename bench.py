@@ -72,10 +72,15 @@ def device_flops_per_step(batch: int, depth: int = DEPTH) -> float:
     from dalle_pytorch_tpu.ops.flash_attention import _block_visit_map
 
     n = TEXT_SEQ + IMAGE_FMAP**2
-    total_tokens = NUM_TEXT + TEXT_SEQ + NUM_IMAGE
     per_layer_params = 16 * DIM * DIM
-    matmul_params = depth * per_layer_params + DIM * total_tokens
-    dense = 3 * 2 * batch * n * matmul_params
+    dense = 3 * 2 * batch * n * depth * per_layer_params
+    # the loss head executes only the block-diagonal live blocks (text
+    # positions x text vocab + image positions x image vocab — the logits
+    # mask zeroes everything else, models/dalle.py:_split_head_loss); the
+    # model-FLOPs convention above still counts the full n x vocab head,
+    # same as it counts full-square attention that flash skips
+    ext = NUM_TEXT + TEXT_SEQ
+    dense += 3 * 2 * batch * DIM * (TEXT_SEQ * ext + IMAGE_FMAP**2 * NUM_IMAGE)
 
     block = _flash_block(n)
     if block:
@@ -213,6 +218,11 @@ def bench_generation(on_cpu: bool):
     params = jax.jit(dalle.init)(
         jax.random.key(0), text, jnp.zeros((1, fmap * fmap), jnp.int32)
     )["params"]
+    # serve in bf16: decode is HBM-bound on weight reads, so f32 master
+    # params would double the bytes per token (generate.py does the same)
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params
+    )
 
     def gen(key):
         return generate_image_tokens(dalle, params, text, key)

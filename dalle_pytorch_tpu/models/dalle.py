@@ -227,29 +227,90 @@ class DALLE(nn.Module):
             mask=self._full_key_mask(mask, n),
             deterministic=deterministic,
         )
-        logits = self._head(out)
-        logits = jnp.where(
-            jnp.asarray(self.logits_mask_np()[:n])[None], NEG_INF, logits
-        )
+        if self.stable:
+            out = divide_max(out)
+        normed = self.final_norm(out)
 
         if not return_loss:
-            return logits
+            logits = self.to_logits(normed)  # compute dtype
+            lmask = jnp.asarray(self.logits_mask_np()[:n])[None]
+            return jnp.where(lmask, NEG_INF, logits.astype(jnp.float32))
 
         assert image is not None, "when training, image tokens must be supplied"
         assert image.shape[1] == self.image_seq_len, (
             f"the loss needs the full image sequence, got {image.shape[1]} of "
             f"{self.image_seq_len} tokens"
         )
-        labels = jnp.concatenate(
-            (text[:, 1:], image + self.num_text_tokens_ext), axis=1
-        )
-        logprobs = jax.nn.log_softmax(logits, axis=-1)
-        token_ll = jnp.take_along_axis(logprobs, labels[..., None], axis=-1)[..., 0]
-        loss_text = -token_ll[:, : self.text_seq_len].mean()
-        loss_img = -token_ll[:, self.text_seq_len :].mean()
+        return self._split_head_loss(normed, text, image)
+
+    def _split_head_loss(self, normed, text, image):
+        """Weighted split CE with a block-diagonal head.
+
+        The logits mask is block-diagonal — text positions may only predict
+        text-vocab tokens, image positions image-vocab tokens (reference
+        dalle_pytorch.py:388-399) — so masked logits have softmax probability
+        0 and gradient 0. Computing only the live blocks of the ``to_logits``
+        matmul is therefore EXACTLY the reference's masked cross-entropy
+        (same loss, same gradients) at under half the head FLOPs: n x vocab
+        becomes text_seq x text_vocab + image_seq x image_vocab. The CE uses
+        logsumexp directly so no (b, n, vocab) f32 log-prob array is ever
+        materialized (the f32 cast fuses into the reduction).
+        """
+        if self.is_initializing():
+            self.to_logits(normed[:, :1])  # materialize the head params
+        p = self.variables["params"]["to_logits"]
+        W = jnp.asarray(p["kernel"], self.dtype)
+        b_ = jnp.asarray(p["bias"], self.dtype)
+        ext = self.num_text_tokens_ext
+        tl = self.text_seq_len
+        h = normed.astype(self.dtype)
+
+        def segment_ll(hidden, cols, labels):
+            logits = hidden @ W[:, cols] + b_[cols]
+            lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+            picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+            return picked.astype(jnp.float32) - lse
+
+        ll_text = segment_ll(h[:, :tl], slice(None, ext), text[:, 1:])
+        ll_img = segment_ll(h[:, tl:], slice(ext, None), image)
+        loss_text = -ll_text.mean()
+        loss_img = -ll_img.mean()
         return (loss_text + self.loss_img_weight * loss_img) / (self.loss_img_weight + 1)
 
     # --------------------------------------------------------------- decode
+
+    def prefill_step(
+        self,
+        tokens: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """Process the first T text positions in ONE parallel pass, filling
+        every decode cache (K/V, token-shift, gMLP gate), and return
+        (b, total_tokens) logits predicting position T.
+
+        The reference decodes the whole prompt token-by-token inside its
+        sampling loop (dalle_pytorch.py:481-486); a parallel prefill removes
+        those T sequential steps and runs MXU-shaped matmuls instead.
+        tokens: (b, T) REMAPPED text ids (bos included), T <= text_len_internal
+        static; equivalent to T sequential ``decode_step`` calls.
+        """
+        b, T = tokens.shape
+        assert T <= self.text_len_internal, (
+            f"prefill covers text positions only, got {T} > {self.text_len_internal}"
+        )
+        emb = self.text_emb(tokens)
+        if not self.rotary_emb:
+            emb = emb + self.text_pos_emb(jnp.arange(T))[None]
+
+        out = self.transformer(
+            emb.astype(self.dtype),
+            mask=self._full_key_mask(mask, self.text_len_internal + self.image_seq_len),
+            deterministic=True,
+            decode=True,
+        )
+        logits = self._head(out[:, -1:])[:, 0]
+        mask_row = jnp.asarray(self.logits_mask_np())[T - 1 : T]
+        return jnp.where(mask_row, NEG_INF, logits)
 
     def decode_step(
         self,

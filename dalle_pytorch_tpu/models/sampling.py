@@ -2,10 +2,12 @@
 
 The reference samples by re-running the full forward pass over the whole
 prefix for every generated token (dalle_pytorch.py:481-486) — O(L^2) attention
-work per token. Here generation is a single ``lax.scan`` over the KV-cached
-``DALLE.decode_step``: every step costs one (1 x L) attention per layer, the
-whole sequence compiles to one XLA program, and prompt prefill is just
-teacher-forcing the scan's first ``known_len`` steps. Randomness flows through
+work per token. Here generation is ONE parallel ``DALLE.prefill_step`` pass
+over the text prompt (filling every decode cache with MXU-shaped matmuls)
+followed by a single ``lax.scan`` over the KV-cached ``DALLE.decode_step``
+for the image positions — each step one (1 x L) attention per layer, the
+whole sequence one XLA program. Priming beyond the text prompt is
+teacher-forced inside the scan via ``known_len``. Randomness flows through
 explicit PRNG keys; top-k fractional-threshold filtering, temperature,
 image-token priming (reference dalle_pytorch.py:470-479) and CLIP reranking
 (dalle_pytorch.py:503-505) all match the reference semantics.
@@ -35,7 +37,7 @@ def init_decode_cache(dalle: DALLE, params, batch_size: int):
     return mutated["cache"]
 
 
-@partial(jax.jit, static_argnums=(0, 5, 8))
+@partial(jax.jit, static_argnums=(0, 5, 8, 9))
 def decode_tokens(
     dalle: DALLE,
     params,
@@ -46,6 +48,7 @@ def decode_tokens(
     temperature: float = 1.0,
     mask: Optional[jnp.ndarray] = None,
     num_steps: Optional[int] = None,
+    prefill_len: int = 0,
 ):
     """Run the decode scan over the internal token buffer.
 
@@ -55,6 +58,16 @@ def decode_tokens(
     one compilation. Text positions hold remapped text ids, image positions
     hold un-offset image token ids. Scans ``num_steps`` (default
     n_internal - 1) input positions and returns the completed buffer.
+
+    ``prefill_len`` (static): process that many leading positions in one
+    parallel ``DALLE.prefill_step`` pass instead of sequential scan steps —
+    callers must guarantee known_len >= prefill_len and prefill_len <=
+    text_len_internal (image generation prefills the whole text prompt,
+    cutting the sequential steps from n_internal-1 to image_seq_len).
+    Note: prefill consumes ONE PRNG split for the whole block where the
+    sequential path consumed one per position, so sampled tokens for a given
+    key differ between prefill_len settings (logits and caches are
+    bit-identical; only the key stream shifts).
     """
     b, n_internal = tokens.shape
     steps = n_internal - 1 if num_steps is None else num_steps
@@ -62,6 +75,32 @@ def decode_tokens(
     ext = dalle.num_text_tokens_ext
 
     cache = init_decode_cache(dalle, params, b)
+
+    def apply_sample(tokens, key, logits, i):
+        """Sample the token at position i+1 from consumed-position-i logits
+        (teacher-forced while i+1 < known_len)."""
+        key, sub = jax.random.split(key)
+        filtered = top_k_filter(logits, thres=filter_thres)
+        sample = jax.random.categorical(sub, filtered / temperature, axis=-1)
+        nxt = i + 1
+        sample = jnp.where(nxt >= text_len_internal, sample - ext, sample)
+        prev = jax.lax.dynamic_slice_in_dim(tokens, nxt, 1, axis=1)[:, 0]
+        new_val = jnp.where(nxt < known_len, prev, sample).astype(tokens.dtype)
+        tokens = jax.lax.dynamic_update_slice(tokens, new_val[:, None], (0, nxt))
+        return tokens, key
+
+    start = 0
+    if prefill_len > 1:
+        logits, mutated = dalle.apply(
+            {"params": params, "cache": cache},
+            tokens[:, :prefill_len],
+            mask,
+            method=DALLE.prefill_step,
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        tokens, key = apply_sample(tokens, key, logits, prefill_len - 1)
+        start = prefill_len
 
     def step(carry, i):
         cache, tokens, key = carry
@@ -74,19 +113,11 @@ def decode_tokens(
             method=DALLE.decode_step,
             mutable=["cache"],
         )
-        key, sub = jax.random.split(key)
-        filtered = top_k_filter(logits, thres=filter_thres)
-        sample = jax.random.categorical(sub, filtered / temperature, axis=-1)
-
-        nxt = i + 1
-        sample = jnp.where(nxt >= text_len_internal, sample - ext, sample)
-        prev = jax.lax.dynamic_slice_in_dim(tokens, nxt, 1, axis=1)[:, 0]
-        new_val = jnp.where(nxt < known_len, prev, sample).astype(tokens.dtype)
-        tokens = jax.lax.dynamic_update_slice(tokens, new_val[:, None], (0, nxt))
+        tokens, key = apply_sample(tokens, key, logits, i)
         return (mutated["cache"], tokens, key), None
 
     (_, tokens, _), _ = jax.lax.scan(
-        step, (cache, tokens, key), jnp.arange(steps, dtype=jnp.int32)
+        step, (cache, tokens, key), jnp.arange(start, steps, dtype=jnp.int32)
     )
     return tokens
 
@@ -126,6 +157,7 @@ def generate_image_tokens(
     tokens = decode_tokens(
         dalle, params, tokens, known_len, key,
         filter_thres=filter_thres, temperature=temperature, mask=mask,
+        prefill_len=dalle.text_len_internal,
     )
     return tokens[:, dalle.text_len_internal :]
 

@@ -429,10 +429,13 @@ class PatternAttention(nn.Module):
     # ------------------------------------------------------------ decode path
 
     def _decode_attend(self, q, k, v, mask, rotary_pos_emb):
-        """Single-token decode against a (b, h, L, d) K/V cache. The new
-        token's row of the pattern mask selects which cached keys it sees."""
+        """Decode against a (b, h, L, d) K/V cache: single-token steps or
+        multi-token prefill blocks (n > 1, e.g. the text prompt in one
+        parallel pass). Each new token's row of the pattern mask selects
+        which cached keys it sees, so attending against the full-length cache
+        (zeros beyond the write index, always masked) matches sequential
+        decode exactly."""
         b, h, n, d = q.shape
-        assert n == 1, "decode mode consumes one token at a time"
         L = self.seq_len
 
         is_init = not self.has_variable("cache", "cached_key")
@@ -450,17 +453,17 @@ class PatternAttention(nn.Module):
 
         idx = cache_index.value
         if rotary_pos_emb is not None:
-            row = jax.lax.dynamic_slice_in_dim(rotary_pos_emb, idx, 1, axis=0)[None, None]
-            q, k, v = (apply_rotary_emb(row, t) for t in (q, k, v))
+            rows = jax.lax.dynamic_slice_in_dim(rotary_pos_emb, idx, n, axis=0)[None, None]
+            q, k, v = (apply_rotary_emb(rows, t) for t in (q, k, v))
         q = q * (d**-0.5)
 
         cached_key.value = jax.lax.dynamic_update_slice_in_dim(cached_key.value, k, idx, axis=2)
         cached_value.value = jax.lax.dynamic_update_slice_in_dim(cached_value.value, v, idx, axis=2)
-        cache_index.value = idx + 1
+        cache_index.value = idx + n
 
         allowed = jax.lax.dynamic_slice_in_dim(
-            jnp.asarray(self.pattern_mask()), idx, 1, axis=0
-        )[None, None]  # (1, 1, 1, L)
+            jnp.asarray(self.pattern_mask()), idx, n, axis=0
+        )[None, None]  # (1, 1, n, L)
         if mask is not None:
             allowed = allowed & mask[:, None, None, :]
         return dense_attend(q, cached_key.value, cached_value.value, allowed, self.stable)

@@ -173,14 +173,34 @@ class PreShiftToken(nn.Module):
 
         pos = pos_var.value
         hist.value = jax.lax.dynamic_update_slice(hist.value, x, (0, pos, 0))
-        prev = jax.lax.dynamic_slice(
-            hist.value, (0, jnp.maximum(pos - 1, 0), 0), (b, 1, d)
-        )
-        row_above = jax.lax.dynamic_slice(
-            hist.value, (0, jnp.maximum(pos - self.image_size, 0), 0), (b, 1, d)
-        )
-        pos_var.value = pos + 1
-        x = shift_tokens_decode(x, pos, prev, row_above, text_len, self.image_size)
+        if n > 1:
+            # prefill: a block of n text positions (n <= text_len and the
+            # whole block must lie inside the text part — callers prefill the
+            # prompt; pos is traced so this cannot be asserted). Only the
+            # text rule applies: first half of channels from the previous
+            # token — block-internal rows shift from the block itself, row 0
+            # from the history (zero when the block starts the sequence).
+            assert n <= text_len, "prefill blocks must stay within the text part"
+            prev_first = jnp.where(
+                pos > 0,
+                jax.lax.dynamic_slice(
+                    hist.value, (0, jnp.maximum(pos - 1, 0), 0), (b, 1, d)
+                ),
+                0.0,
+            )
+            prev_block = jnp.concatenate((prev_first, x[:, :-1]), axis=1)
+            pos_var.value = pos + n
+            half = d // 2
+            x = jnp.concatenate((prev_block[..., :half], x[..., half:]), axis=-1)
+        else:
+            prev = jax.lax.dynamic_slice(
+                hist.value, (0, jnp.maximum(pos - 1, 0), 0), (b, 1, d)
+            )
+            row_above = jax.lax.dynamic_slice(
+                hist.value, (0, jnp.maximum(pos - self.image_size, 0), 0), (b, 1, d)
+            )
+            pos_var.value = pos + 1
+            x = shift_tokens_decode(x, pos, prev, row_above, text_len, self.image_size)
         return self.fn(x, **inner_kwargs)
 
 
@@ -253,12 +273,12 @@ class SpatialGatingUnit(nn.Module):
         return res * gate
 
     def _decode_gate(self, x, res, gate, weight, bias):
-        """One-token decode: the gate mixes over the full (normalized) gate
-        history, so a cache holds it — without this, a 1-token input would see
-        only w[:1, :1] instead of its history row and sampling with 'mlp'
-        layers would silently produce garbage."""
+        """Decode against the gate-history cache: the gate mixes over the full
+        (normalized) gate history — without the cache, a 1-token input would
+        see only w[:1, :1] instead of its history row and sampling with 'mlp'
+        layers would silently produce garbage. Handles single-token steps and
+        multi-token prefill blocks (n > 1) alike."""
         b, n, dh = gate.shape
-        assert n == 1, "decode mode consumes one token at a time"
         is_init = not self.has_variable("cache", "gate_hist")
         hist = self.variable(
             "cache", "gate_hist", jnp.zeros, (b, self.seq_len, dh), gate.dtype
@@ -271,13 +291,14 @@ class SpatialGatingUnit(nn.Module):
 
         idx = idx_var.value
         hist.value = jax.lax.dynamic_update_slice(hist.value, gate, (0, idx, 0))
-        w_row = jax.lax.dynamic_slice(weight, (idx, 0), (1, self.seq_len))
+        w_rows = jax.lax.dynamic_slice(weight, (idx, 0), (n, self.seq_len))
         if self.causal:
             cols = jnp.arange(self.seq_len)
-            w_row = jnp.where(cols[None, :] <= idx, w_row, 0.0)
-        out = jnp.einsum("bnd,mn->bmd", hist.value, w_row.astype(x.dtype))
-        out = out + jax.lax.dynamic_slice(bias, (idx,), (1,))[:, None].astype(x.dtype)
-        idx_var.value = idx + 1
+            rows = idx + jnp.arange(n)
+            w_rows = jnp.where(cols[None, :] <= rows[:, None], w_rows, 0.0)
+        out = jnp.einsum("bnd,mn->bmd", hist.value, w_rows.astype(x.dtype))
+        out = out + jax.lax.dynamic_slice(bias, (idx,), (n,))[:, None].astype(x.dtype)
+        idx_var.value = idx + n
         return res * out
 
 

@@ -30,6 +30,10 @@ def parse_args():
     parser.add_argument("--gentxt", action="store_true",
                         help="complete the prompt with the model before generating images")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--fp16", "--bf16", dest="bf16", action="store_true",
+                        help="serve in bf16: halves HBM weight traffic, the "
+                             "decode bottleneck (analog of the reference's "
+                             "fp16 generation)")
     # local weight files for checkpoints trained against a frozen pretrained
     # VAE (whose weights are not bundled in the DALLE checkpoint)
     parser.add_argument("--vqgan_model_path", type=str, default=None)
@@ -64,6 +68,13 @@ def main():
         },
     )
     assert vae is not None, "checkpoint carries no VAE — cannot decode images"
+
+    if args.bf16:
+        dalle = dalle.clone(dtype=jnp.bfloat16)
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+            params,
+        )
 
     if args.chinese:
         tokenizer = ChineseTokenizer()

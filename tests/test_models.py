@@ -149,6 +149,31 @@ class TestDALLE:
         flat = jax.tree_util.tree_leaves(grads)
         assert all(np.isfinite(np.asarray(g)).all() for g in flat)
 
+    def test_split_head_loss_matches_masked_ce(self):
+        """The block-diagonal head loss must equal the reference's masked
+        full-vocab log_softmax CE exactly (the logits mask is block-diagonal,
+        so skipping the dead blocks changes no value and no gradient)."""
+        dalle = small_dalle()
+        text, image = dalle_inputs(dalle)
+        params = dalle.init(jax.random.key(0), text, image)["params"]
+        loss = float(dalle.apply({"params": params}, text, image, return_loss=True))
+
+        logits = dalle.apply({"params": params}, text, image)  # masked, f32
+        labels = np.concatenate(
+            (
+                np.asarray(dalle.remap_text(text))[:, 1:],
+                np.asarray(image) + dalle.num_text_tokens_ext,
+            ),
+            axis=1,
+        )
+        lp = np.asarray(jax.nn.log_softmax(logits, axis=-1))
+        tll = np.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
+        tl = dalle.text_seq_len
+        ref = (-tll[:, :tl].mean() + dalle.loss_img_weight * -tll[:, tl:].mean()) / (
+            dalle.loss_img_weight + 1
+        )
+        np.testing.assert_allclose(loss, ref, atol=2e-3)
+
     def test_text_only_forward(self):
         dalle = small_dalle()
         text, _ = dalle_inputs(dalle)
@@ -194,6 +219,56 @@ class TestDALLE:
                 atol=2e-3,
                 rtol=1e-3,
                 err_msg=f"decode/forward mismatch at position {i} (config {kw})",
+            )
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            dict(),
+            dict(rotary_emb=False),
+            dict(attn_types=("conv_like", "axial_col"), stable=True),
+            dict(attn_types=("full", "mlp"), rotary_emb=False),
+        ],
+    )
+    def test_prefill_matches_sequential_decode(self, kw):
+        """prefill_step (one parallel pass over the text prompt) must leave
+        the caches and logits exactly as T sequential decode_step calls."""
+        dalle = small_dalle(**kw)
+        text, image = dalle_inputs(dalle, b=2)
+        params = dalle.init(jax.random.key(0), text, image)["params"]
+        internal = np.asarray(dalle.remap_text(text))
+        T = dalle.text_len_internal
+
+        # sequential reference
+        cache = init_decode_cache(dalle, params, batch_size=2)
+        for i in range(T):
+            seq_logits, mutated = dalle.apply(
+                {"params": params, "cache": cache},
+                jnp.asarray(internal[:, i]),
+                jnp.array(i, jnp.int32),
+                method=DALLE.decode_step,
+                mutable=["cache"],
+            )
+            cache = mutated["cache"]
+
+        # parallel prefill
+        cache2 = init_decode_cache(dalle, params, batch_size=2)
+        pre_logits, mutated2 = dalle.apply(
+            {"params": params, "cache": cache2},
+            jnp.asarray(internal[:, :T]),
+            method=DALLE.prefill_step,
+            mutable=["cache"],
+        )
+        np.testing.assert_allclose(
+            np.asarray(pre_logits), np.asarray(seq_logits), atol=2e-3, rtol=1e-3
+        )
+        flat1 = jax.tree_util.tree_leaves_with_path(cache)
+        flat2 = jax.tree_util.tree_leaves_with_path(mutated2["cache"])
+        for (p1, a), (p2, b) in zip(flat1, flat2):
+            assert p1 == p2
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-3, rtol=1e-3,
+                err_msg=f"cache mismatch at {jax.tree_util.keystr(p1)} ({kw})",
             )
 
 
