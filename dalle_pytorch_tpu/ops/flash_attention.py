@@ -13,12 +13,15 @@ full (block_q x d x block_k) matmuls:
 - backward: recompute-based (FlashAttention-2 decomposition, no stored
   probabilities): one kernel accumulates dq over k blocks, another (dk, dv)
   over q blocks;
-- masking: ``causal=True`` is analytic (above-diagonal blocks contribute no
-  FLOPs and their K/V DMAs are elided by re-fetching the previous live
-  block); an optional static (n, n) pattern mask (ops/masks.py) is streamed
+- masking: ``causal=True`` is analytic (above-diagonal blocks execute no
+  dots); an optional static (n, n) pattern mask (ops/masks.py) is streamed
   blockwise for sparse/axial/conv layouts with all-empty blocks skipped the
   same way. This one kernel therefore covers both the reference's dense
   causal attention and its DeepSpeed variable-sparsity kernel semantics.
+  Skipped blocks still DMA their K/V block: index_maps must stay affine in
+  the grid indices — an earlier revision routed them through the
+  scalar-prefetch table to re-fetch the last live block, which defeats
+  Mosaic's DMA pipelining and measured 23x slower at block 256 on v5e.
 
 Parity is tested against the dense masked oracle (ops.attention.dense_attend)
 in interpret mode on CPU and compiled on TPU.
@@ -81,27 +84,11 @@ def _block_visit_map(
     return visit
 
 
-def _last_live_table(visit: np.ndarray) -> np.ndarray:
-    """For each grid step, the most recent live inner-index — skipped steps
-    re-fetch that block so their DMA costs nothing new."""
-    out = np.zeros_like(visit)
-    for a in range(visit.shape[0]):
-        live = 0
-        for b in range(visit.shape[1]):
-            if visit[a, b] > 0:
-                live = b
-            out[a, b] = live
-    return out
-
-
 def _scalar_table(visit: np.ndarray) -> np.ndarray:
-    """(2, nq*nk) int32 scalar-prefetch payload: row 0 = per-(outer, inner)
-    visit class consumed by the kernel body, row 1 = last-live inner index
-    consumed by the K/V index_maps (skipped steps re-fetch the previous live
-    block, so their DMA is a no-op)."""
-    return np.stack(
-        [visit.reshape(-1), _last_live_table(visit).reshape(-1)]
-    ).astype(np.int32)
+    """(1, nq*nk) int32 scalar-prefetch payload: the per-(outer, inner) visit
+    class consumed by the kernel body to skip compute on dead blocks. (Index
+    maps deliberately do NOT consult it — see the module docstring.)"""
+    return visit.reshape(1, -1).astype(np.int32)
 
 
 # ------------------------------------------------------------------ kernels
@@ -278,11 +265,18 @@ def _kernel_cost(
     cost analysis (bench.py MFU) and the scheduler see the kernel's real
     FLOPs instead of zero for the opaque custom call."""
     live = int((visit > 0).sum())
+    nq, nk = visit.shape
     per_dot = 2 * block_q * block_k * d
     return pl.CostEstimate(
         flops=bh * live * dots_per_block * per_dot,
         transcendentals=bh * live * block_q * block_k,  # exp
-        bytes_accessed=bh * live * (block_q + 2 * block_k) * d * dtype_bytes,
+        # K/V DMA happens on EVERY grid step (affine index maps — dead blocks
+        # skip compute, not traffic); the q block repeats across the inner
+        # dimension so Mosaic fetches it once per outer step
+        bytes_accessed=bh
+        * (nq * nk * 2 * block_k + nq * block_q)
+        * d
+        * dtype_bytes,
     )
 
 
@@ -327,11 +321,11 @@ def _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interp
     bh = b * h
     qf, kf, vf = (t.reshape(bh, n, d) for t in (q, k, v))
 
-    # index_maps under PrefetchScalarGridSpec receive the scalar-prefetch ref
-    # as a trailing argument after the grid indices; K/V block selection reads
-    # the last-live table out of it (row 1)
+    # index_maps under PrefetchScalarGridSpec receive the scalar-prefetch
+    # ref as a trailing argument after the grid indices, but must stay affine
+    # in the grid indices (module docstring)
     def kv_im(bhi, qb, kb, s):
-        return (bhi, s[1, qb * nk + kb], 0)
+        return (bhi, kb, 0)
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0)),
@@ -414,7 +408,7 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
 
     # ---- dq over k blocks --------------------------------------------------
     def kv_im(bhi, qb, kb, s):
-        return (bhi, s[1, qb * nk + kb], 0)
+        return (bhi, kb, 0)
 
     dq_specs = [
         pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0)),
@@ -455,10 +449,10 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
     visit_t = np.ascontiguousarray(visit.T)
 
     def q_im(bhi, kb, qb, s):
-        return (bhi, s[1, kb * nq + qb], 0)
+        return (bhi, qb, 0)
 
     def row_im(bhi, kb, qb, s):
-        return (bhi, 0, s[1, kb * nq + qb])
+        return (bhi, 0, qb)
 
     dkv_specs = [
         pl.BlockSpec((1, block_q, d), q_im),
