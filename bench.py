@@ -86,10 +86,11 @@ def device_flops_per_step(batch: int, depth: int = DEPTH) -> float:
     if block:
         visit = _block_visit_map(n // block, n // block, block, block, True, None)
         live = int((visit > 0).sum())
-        # fwd 2 dots + dq 4 + dkv 6 = 12 block-dots per live block
-        attn = depth * batch * HEADS * live * 12 * 2 * block * block * DIM_HEAD
+        # fwd 2 dots + dq 3 (s, dp, dq) + dkv 4 (s, dv, dp, dk) = 9
+        # block-dots per live block (matches the kernels' CostEstimates)
+        attn = depth * batch * HEADS * live * 9 * 2 * block * block * DIM_HEAD
     else:
-        attn = depth * 12 * batch * n * n * (HEADS * DIM_HEAD) // 2
+        attn = depth * 9 * batch * n * n * (HEADS * DIM_HEAD) // 2
     return dense + attn
 
 

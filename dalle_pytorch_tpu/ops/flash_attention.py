@@ -259,22 +259,26 @@ def _prep(q, pattern_mask, block_q, block_k, causal):
 
 def _kernel_cost(
     visit: np.ndarray, bh: int, block_q: int, block_k: int, d: int,
-    dots_per_block: int, dtype_bytes: int,
+    dots_per_block: int, per_step_rows: int, per_outer_rows: int,
+    dtype_bytes: int,
 ) -> pl.CostEstimate:
     """Cost of one pass over the live blocks — fed to XLA so compiled-module
-    cost analysis (bench.py MFU) and the scheduler see the kernel's real
-    FLOPs instead of zero for the opaque custom call."""
+    cost analysis and the scheduler see the kernel's real FLOPs instead of
+    zero for the opaque custom call. ``dots_per_block``: dot_generals the
+    body executes per live block (fwd 2: s, o-acc; dq 3: s, dp, dq;
+    dkv 4: s, dv, dp, dk). Streamed-operand DMA happens on EVERY grid step
+    (affine index maps — dead blocks skip compute, not traffic):
+    ``per_step_rows`` rows of d move per inner step, ``per_outer_rows`` rows
+    once per outer step (operands whose block index only depends on the
+    outer grid dimension, plus outputs)."""
     live = int((visit > 0).sum())
-    nq, nk = visit.shape
+    n_outer, n_inner = visit.shape
     per_dot = 2 * block_q * block_k * d
     return pl.CostEstimate(
         flops=bh * live * dots_per_block * per_dot,
         transcendentals=bh * live * block_q * block_k,  # exp
-        # K/V DMA happens on EVERY grid step (affine index maps — dead blocks
-        # skip compute, not traffic); the q block repeats across the inner
-        # dimension so Mosaic fetches it once per outer step
         bytes_accessed=bh
-        * (nq * nk * 2 * block_k + nq * block_q)
+        * (n_outer * n_inner * per_step_rows + n_outer * per_outer_rows)
         * d
         * dtype_bytes,
     )
@@ -367,7 +371,8 @@ def _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interp
         scalar=jnp.asarray(_scalar_table(visit)),
         operands=operands,
         interpret=interpret,
-        cost=_kernel_cost(visit, bh, block_q, block_k, d, 2, q.dtype.itemsize),
+        cost=_kernel_cost(visit, bh, block_q, block_k, d, 2,
+                          2 * block_k, 2 * block_q, q.dtype.itemsize),
     )
     return o.reshape(b, h, n, d), lse.reshape(b, h, n)
 
@@ -442,7 +447,8 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
         scalar=jnp.asarray(_scalar_table(visit)),
         operands=[qf, kf, vf, *mask_op, dof, lsef, deltaf],
         interpret=interpret,
-        cost=_kernel_cost(visit, bh, block_q, block_k, d, 4, q.dtype.itemsize),
+        cost=_kernel_cost(visit, bh, block_q, block_k, d, 3,
+                          2 * block_k, 3 * block_q, q.dtype.itemsize),
     )
 
     # ---- dk/dv over q blocks ----------------------------------------------
@@ -493,7 +499,8 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
         scalar=jnp.asarray(_scalar_table(visit_t)),
         operands=[qf, kf, vf, *mask_op, dof, lsef, deltaf],
         interpret=interpret,
-        cost=_kernel_cost(visit_t, bh, block_q, block_k, d, 6, q.dtype.itemsize),
+        cost=_kernel_cost(visit_t, bh, block_q, block_k, d, 4,
+                          2 * block_q, 4 * block_k, q.dtype.itemsize),
     )
     return dq.reshape(b, h, n, d), dk.reshape(b, h, n, d), dv.reshape(b, h, n, d)
 

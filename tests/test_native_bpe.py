@@ -115,6 +115,31 @@ def test_tokenize_contract(pair):
     assert trunc.shape == (1, 8)
 
 
+def test_concurrent_encode_thread_safety(pair):
+    """The data loader prefetches on a thread; concurrent encodes against
+    one engine (shared token cache behind a mutex) must stay byte-exact."""
+    import threading
+
+    nt, pt = pair
+    texts = [f"caption number {i} with a {w} object" for i in range(50)
+             for w in ("red", "blue", "shiny")]
+    expected = [pt.encode(t) for t in texts]
+    results = {}
+
+    def worker(tid):
+        out = [nt.encode(t) for t in texts]
+        results[tid] = out
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 4, f"worker thread(s) died: only {sorted(results)}"
+    for tid, out in results.items():
+        assert out == expected, f"thread {tid} diverged"
+
+
 def test_get_tokenizer_prefers_native(monkeypatch):
     import dalle_pytorch_tpu.data.tokenizers as tok
 
