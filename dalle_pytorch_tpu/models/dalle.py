@@ -70,6 +70,8 @@ class DALLE(nn.Module):
     sparse_layout_seed: int = 0
     use_flash: bool = True
     sp_axis: Optional[str] = None
+    pp_axis: Optional[str] = None
+    pp_microbatches: int = 4
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -146,6 +148,8 @@ class DALLE(nn.Module):
             sparse_layout_seed=self.sparse_layout_seed,
             use_flash=self.use_flash,
             sp_axis=self.sp_axis,
+            pp_axis=self.pp_axis,
+            pp_microbatches=self.pp_microbatches,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )

@@ -74,6 +74,8 @@ class Transformer(nn.Module):
     sparse_layout_seed: int = 0
     use_flash: bool = True
     sp_axis: Optional[str] = None
+    pp_axis: Optional[str] = None
+    pp_microbatches: int = 4
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -202,6 +204,16 @@ class Transformer(nn.Module):
         rot_np = self.rotary_table()
         rot = jnp.asarray(rot_np) if rot_np is not None else None
 
+        if (
+            self.pp_axis is not None
+            and not decode
+            and not self.is_initializing()
+        ):
+            from ..parallel.context import axis_extent
+
+            if axis_extent(self.pp_axis) > 1:
+                return self._pp_forward(x, mask, rot, deterministic)
+
         sequential = (
             self.is_initializing()
             or decode
@@ -236,6 +248,107 @@ class Transformer(nn.Module):
         out = reversible_sequence(tuple(fns), params, jnp.concatenate((x, x), -1), kwargs)
         y1, y2 = jnp.split(out, 2, axis=-1)
         return (y1 + y2) / 2
+
+    def _pp_forward(self, x, mask, rot, deterministic):
+        """GPipe pipeline execution over the ``pp_axis`` mesh axis
+        (parallel/pipeline.py): per-layer params are stacked and staged, the
+        microbatch schedule runs as one shard_map. Requires homogeneous
+        layers (uniform attn_types; 'mlp' has different params and 'sparse'
+        a different mask per layer), no dropout RNG threading, and no
+        reversible mode; composes with dp/fsdp (tp/sp inside a pipeline
+        stage would need nested shard_map, which JAX does not allow)."""
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.context import active_mesh, axis_extent, batch_axes
+        from ..parallel.pipeline import gpipe, stack_layer_params
+
+        kinds = set(self.layer_kinds)
+        if len(kinds) != 1 or kinds & {"mlp", "sparse"}:
+            raise ValueError(
+                f"pipeline parallelism needs one uniform attention type "
+                f"(not mlp/sparse, whose layers are heterogeneous); got "
+                f"{self.attn_types}"
+            )
+        if self.reversible:
+            raise ValueError("pipeline parallelism excludes reversible mode")
+        if not deterministic and (self.attn_dropout > 0 or self.ff_dropout > 0):
+            raise ValueError(
+                "dropout under pipeline parallelism is not supported (per-"
+                "layer RNG threading through the stage schedule)"
+            )
+        for ax in ("tp", "sp"):
+            if axis_extent(ax) > 1:
+                raise ValueError(
+                    f"pp composes with dp/fsdp only; mesh has {ax} > 1 "
+                    f"(a pipeline stage cannot open a nested shard_map)"
+                )
+
+        if mask is not None:
+            raise ValueError(
+                "key-padding masks under pipeline parallelism are not "
+                "supported yet (the mask would need microbatching in sync "
+                "with the activation schedule)"
+            )
+
+        mesh = active_mesh()
+        pp = int(mesh.shape[self.pp_axis])
+        assert self.depth % pp == 0, (
+            f"depth {self.depth} not divisible by pp={pp}"
+        )
+        dp_total = int(
+            np.prod([mesh.shape[a] for a in (batch_axes(mesh) or ())])
+        )
+        local_b = x.shape[0] // dp_total
+        # largest microbatch count that divides the per-shard batch
+        n_micro = max(
+            m
+            for m in range(1, min(self.pp_microbatches, local_b) + 1)
+            if local_b % m == 0
+        )
+        if n_micro < min(self.pp_microbatches, pp):
+            import warnings
+
+            warnings.warn(
+                f"pipeline microbatches reduced to {n_micro} (requested "
+                f"{self.pp_microbatches}; per-shard batch {local_b} has no "
+                f"larger divisor) — the GPipe bubble grows accordingly; "
+                f"pick a batch size divisible by dp*fsdp*microbatches"
+            )
+
+        fns, params, kwargs = self._pure_blocks(mask, rot, deterministic)
+        attn_f, ff_f = fns[0]
+        akw, fkw = kwargs[0]
+        stacked = stack_layer_params(
+            [{"attn": pa, "ff": pf} for pa, pf in params]
+        )
+        # (depth, ...) -> (pp, depth // pp, ...) so dim 0 shards over pp
+        stacked = jax.tree_util.tree_map(
+            lambda l: l.reshape(pp, self.depth // pp, *l.shape[1:]), stacked
+        )
+
+        def layer_fn(p, t):
+            t = t + attn_f(p["attn"], t, akw)
+            return t + ff_f(p["ff"], t, fkw)
+
+        if self.remat:
+            # honor --remat inside the pipeline: recompute each layer's
+            # activations in backward instead of storing them across the
+            # n_micro + pp - 1 scan ticks
+            layer_fn = jax.checkpoint(layer_fn)
+
+        p_specs = jax.tree_util.tree_map(lambda _: P(self.pp_axis), stacked)
+        x_spec = P(batch_axes(mesh))
+
+        def body(p, t):
+            return gpipe(
+                layer_fn, p, t,
+                axis_name=self.pp_axis, n_stages=pp, n_micro=n_micro,
+            )
+
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(p_specs, x_spec), out_specs=x_spec,
+            check_vma=False,
+        )(stacked, x)
 
     def _pure_blocks(self, mask, rot, deterministic):
         """Unbound-apply closures + param subtrees + traced-array kwargs for

@@ -1,5 +1,6 @@
 from .context import activate_mesh, active_mesh
 from .mesh import AXIS_NAMES, MeshRuntime, init_distributed, make_runtime
+from .pipeline import gpipe, stack_layer_params
 from .sharding import (
     DEFAULT_RULES,
     opt_state_shardings,
@@ -17,6 +18,7 @@ __all__ = [
     "activate_mesh",
     "active_mesh",
     "create_train_state",
+    "gpipe",
     "init_distributed",
     "make_eval_step",
     "make_runtime",
@@ -25,4 +27,5 @@ __all__ = [
     "params_shardings",
     "partition_spec",
     "shard_pytree",
+    "stack_layer_params",
 ]

@@ -42,13 +42,17 @@ def batch_axes(mesh: Mesh):
     return names or None
 
 
-def sp_extent(sp_axis: Optional[str]) -> int:
-    """Extent of the sequence-parallel axis under the active mesh (1 when no
-    mesh is active or the axis is absent/trivial)."""
+def axis_extent(axis: Optional[str]) -> int:
+    """Extent of a named mesh axis under the active mesh (1 when no mesh is
+    active or the axis is absent/trivial)."""
     mesh = active_mesh()
-    if sp_axis is None or mesh is None:
+    if axis is None or mesh is None:
         return 1
-    return int(mesh.shape.get(sp_axis, 1))
+    return int(mesh.shape.get(axis, 1))
+
+
+# the sequence-parallel call sites read better with the specific name
+sp_extent = axis_extent
 
 
 def constrain_seq_sharded(x, sp_axis: Optional[str], seq_dim: int = 1):

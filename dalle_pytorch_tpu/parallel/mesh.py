@@ -23,6 +23,7 @@ Axes:
         the reference's config-gated DeepSpeed ZeRO, train_dalle.py:483-488)
   tp    tensor parallelism over attention heads / FF hidden (beyond-parity)
   sp    sequence/context parallelism (ring attention)
+  pp    pipeline parallelism (GPipe microbatch schedule, parallel/pipeline.py)
 """
 
 from __future__ import annotations
@@ -35,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXIS_NAMES = ("dp", "fsdp", "tp", "sp")
+AXIS_NAMES = ("dp", "fsdp", "tp", "sp", "pp")
 
 
 def init_distributed(
@@ -174,6 +175,7 @@ def make_runtime(
     fsdp: int = 1,
     tp: int = 1,
     sp: int = 1,
+    pp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> MeshRuntime:
     """Build a MeshRuntime over the available devices.
@@ -184,12 +186,12 @@ def make_runtime(
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    rest = fsdp * tp * sp
-    assert n % rest == 0, f"{n} devices not divisible by fsdp*tp*sp={rest}"
+    rest = fsdp * tp * sp * pp
+    assert n % rest == 0, f"{n} devices not divisible by fsdp*tp*sp*pp={rest}"
     if dp is None:
         dp = n // rest
     assert dp * rest == n, (
-        f"mesh {dp}x{fsdp}x{tp}x{sp} != {n} available devices"
+        f"mesh {dp}x{fsdp}x{tp}x{sp}x{pp} != {n} available devices"
     )
-    dev_array = np.asarray(devices).reshape(dp, fsdp, tp, sp)
+    dev_array = np.asarray(devices).reshape(dp, fsdp, tp, sp, pp)
     return MeshRuntime(mesh=Mesh(dev_array, AXIS_NAMES))

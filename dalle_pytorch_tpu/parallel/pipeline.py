@@ -1,0 +1,104 @@
+"""Pipeline parallelism: GPipe-style microbatched execution over a ``pp``
+mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.2 — its only
+distribution is data parallel); this is a beyond-parity scaling axis for
+models whose depth outgrows one chip. Design, TPU-native:
+
+- layer parameters are STACKED along a leading (n_stages, layers_per_stage)
+  axis and sharded over ``pp`` on dim 0, so each device materializes only its
+  own stage's weights (GSPMD inserts the reshard at the shard_map boundary);
+- the schedule is the classic GPipe fill-drain loop as ONE ``lax.scan`` over
+  n_micro + n_stages - 1 ticks: stage 0 feeds the next microbatch, every
+  stage applies its layers, activations hop stage->stage+1 via
+  ``jax.lax.ppermute`` (one ICI neighbor hop per tick), and the last stage's
+  outputs are collected;
+- outputs return to every pp rank with a single masked ``psum`` after the
+  loop, so the (replicated) head/loss needs no special casing;
+- the whole schedule is differentiable — reverse-mode AD through the scan +
+  ppermute yields the standard backward pipeline (activations recomputable
+  per stage via the surrounding remat policy if desired).
+
+Homogeneity requirement: every layer must share one param structure and one
+apply function (true for this framework's attention+FF blocks whenever
+``attn_types`` is uniform; gMLP or mixed-pattern stacks cannot be staged).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def stack_layer_params(per_layer: Sequence[Any]) -> Any:
+    """Stack structurally-identical per-layer param trees into one tree with
+    a leading layer axis on every leaf."""
+    first = jax.tree_util.tree_structure(per_layer[0])
+    for i, p in enumerate(per_layer[1:], 1):
+        assert jax.tree_util.tree_structure(p) == first, (
+            f"layer {i} param structure differs from layer 0 — pipeline "
+            f"stages require homogeneous layers (uniform attn_types)"
+        )
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_layer)
+
+
+def gpipe(
+    layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stacked_params: Any,
+    x: jnp.ndarray,
+    *,
+    axis_name: str,
+    n_stages: int,
+    n_micro: int,
+) -> jnp.ndarray:
+    """Per-shard GPipe body (run under ``shard_map``).
+
+    layer_fn(layer_params, x) -> x applies ONE layer. ``stacked_params``:
+    local (1, layers_per_stage, ...) leaves (this stage's slice of the
+    global (n_layers, ...) stack). x: the FULL local batch (b, n, d) — it is
+    split into ``n_micro`` microbatches along dim 0. Returns the full
+    (b, n, d) output, identical on every pp rank.
+    """
+    stage = jax.lax.axis_index(axis_name)
+    b = x.shape[0]
+    assert b % n_micro == 0, f"batch {b} not divisible by n_micro={n_micro}"
+    mb = b // n_micro
+    micro = x.reshape(n_micro, mb, *x.shape[1:])
+
+    def stage_fn(carry_x):
+        p_local = jax.tree_util.tree_map(lambda l: l[0], stacked_params)
+        layers = jax.tree_util.tree_leaves(p_local)[0].shape[0]
+        y = carry_x
+        for li in range(layers):
+            p_layer = jax.tree_util.tree_map(lambda l, li=li: l[li], p_local)
+            y = layer_fn(p_layer, y)
+        return y
+
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    n_ticks = n_micro + n_stages - 1
+
+    def tick(carry, t):
+        buf = carry  # (mb, n, d): activation entering this stage this tick
+        # stage 0 picks up microbatch t (clamped; ticks >= n_micro feed
+        # garbage that never reaches the collected outputs)
+        feed = jax.lax.dynamic_index_in_dim(
+            micro, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False
+        )
+        inp = jnp.where(stage == 0, feed, buf)
+        out = stage_fn(inp)
+        # collect: the last stage emits microbatch t - (n_stages - 1)
+        emit = jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out))
+        nxt = jax.lax.ppermute(out, axis_name, perm)
+        return nxt, emit
+
+    zeros = jnp.zeros((mb, *x.shape[1:]), x.dtype)
+    _, emitted = jax.lax.scan(tick, zeros, jnp.arange(n_ticks, dtype=jnp.int32))
+
+    # emitted[t] is live only on the last stage and only for ticks
+    # t >= n_stages - 1 (microbatch index t - n_stages + 1); a single psum
+    # replicates the collected outputs to every pp rank
+    out = emitted[n_stages - 1 :]
+    out = jax.lax.psum(out, axis_name)
+    return out.reshape(b, *x.shape[1:])

@@ -62,7 +62,7 @@ class TestMeshRuntime:
 
     def test_mixed_mesh_shapes(self):
         rt = make_runtime(fsdp=2, tp=2)
-        assert rt.mesh.shape == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+        assert rt.mesh.shape == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1, "pp": 1}
         assert rt.data_spec == P(("dp", "fsdp"))
 
     def test_bad_mesh_rejected(self):
@@ -125,10 +125,41 @@ class TestTrainStep:
 
     def test_fsdp_tp_matches_dp(self):
         """ZeRO-style param sharding + tensor parallelism must be numerically
-        equivalent to pure data parallelism."""
-        dp8 = self._run(make_runtime())
-        mixed = self._run(make_runtime(dp=2, fsdp=2, tp=2))
-        np.testing.assert_allclose(dp8, mixed, rtol=2e-4)
+        equivalent to pure data parallelism: same loss, same gradients.
+
+        The assertion is on loss + gradients, not a multi-step trajectory:
+        different meshes legally reorder floating-point reductions (~1e-7
+        relative), and Adam's early steps amplify any such perturbation
+        (update ~ g/sqrt(g^2) is sign-like for small g), so step-3 losses
+        across meshes can drift to ~1e-3 with bit-different-but-correct
+        gradients."""
+        from dalle_pytorch_tpu.parallel import shard_pytree
+
+        dalle = small_dalle()
+        batch = make_batch(dalle)
+        params = dalle.init(jax.random.key(0), batch["text"], batch["image"])[
+            "params"
+        ]
+        loss_fn = dalle_loss_fn(dalle)
+
+        def value_grad(runtime):
+            sh = params_shardings(params, runtime.mesh)
+            p = shard_pytree(params, sh)
+            with runtime.activate():
+                l, g = jax.jit(
+                    jax.value_and_grad(lambda p: loss_fn(p, batch, None)),
+                    in_shardings=(sh,),
+                    out_shardings=(None, sh),
+                )(p)
+            return float(l), jax.tree_util.tree_map(np.asarray, g)
+
+        l_dp, g_dp = value_grad(make_runtime())
+        l_mx, g_mx = value_grad(make_runtime(dp=2, fsdp=2, tp=2))
+        np.testing.assert_allclose(l_dp, l_mx, rtol=1e-5)
+        for a, e in zip(
+            jax.tree_util.tree_leaves(g_mx), jax.tree_util.tree_leaves(g_dp)
+        ):
+            np.testing.assert_allclose(a, e, atol=1e-5, rtol=1e-3)
 
     def test_loss_decreases(self):
         losses = self._run(make_runtime(fsdp=4, tp=2), n_steps=10)

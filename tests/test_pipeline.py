@@ -1,0 +1,199 @@
+"""Pipeline-parallelism tests: the GPipe schedule (parallel/pipeline.py) and
+the model-level pp execution path must be pure layout changes — identical
+outputs and gradients to sequential execution, on the 8-device virtual CPU
+mesh (conftest.py)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dalle_pytorch_tpu.models import DALLE
+from dalle_pytorch_tpu.parallel import gpipe, make_runtime, stack_layer_params
+
+
+def pp_mesh(n=4):
+    return Mesh(np.asarray(jax.devices()[:n]).reshape(n), ("pp",))
+
+
+def toy_layer(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+@pytest.mark.parametrize("n_micro", [1, 2, 4])
+def test_gpipe_matches_sequential(n_micro):
+    stages, depth, b, n, d = 4, 8, 8, 6, 16
+    rng = np.random.RandomState(0)
+    per_layer = [
+        {
+            "w": jnp.asarray(rng.randn(d, d) * 0.3, jnp.float32),
+            "b": jnp.asarray(rng.randn(d) * 0.1, jnp.float32),
+        }
+        for _ in range(depth)
+    ]
+    x = jnp.asarray(rng.randn(b, n, d), jnp.float32)
+
+    expected = x
+    for p in per_layer:
+        expected = toy_layer(p, expected)
+
+    stacked = stack_layer_params(per_layer)
+    stacked = jax.tree_util.tree_map(
+        lambda l: l.reshape(stages, depth // stages, *l.shape[1:]), stacked
+    )
+    mesh = pp_mesh(stages)
+    p_specs = jax.tree_util.tree_map(lambda _: P("pp"), stacked)
+    fn = jax.jit(
+        jax.shard_map(
+            functools.partial(
+                gpipe, toy_layer, axis_name="pp", n_stages=stages,
+                n_micro=n_micro,
+            ),
+            mesh=mesh,
+            in_specs=(p_specs, P(None)),
+            out_specs=P(None),
+            check_vma=False,
+        )
+    )
+    out = fn(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
+
+
+def test_gpipe_gradients_match_sequential():
+    stages, depth, b, n, d = 2, 4, 4, 5, 8
+    rng = np.random.RandomState(1)
+    per_layer = [
+        {
+            "w": jnp.asarray(rng.randn(d, d) * 0.3, jnp.float32),
+            "b": jnp.asarray(rng.randn(d) * 0.1, jnp.float32),
+        }
+        for _ in range(depth)
+    ]
+    x = jnp.asarray(rng.randn(b, n, d), jnp.float32)
+    w = jnp.asarray(rng.randn(b, n, d), jnp.float32)
+
+    def seq_loss(layers):
+        t = x
+        for p in layers:
+            t = toy_layer(p, t)
+        return (t * w).sum()
+
+    g_seq = jax.jit(jax.grad(seq_loss))(per_layer)
+
+    mesh = pp_mesh(stages)
+
+    def pp_loss(layers):
+        stacked = stack_layer_params(layers)
+        stacked = jax.tree_util.tree_map(
+            lambda l: l.reshape(stages, depth // stages, *l.shape[1:]), stacked
+        )
+        p_specs = jax.tree_util.tree_map(lambda _: P("pp"), stacked)
+        out = jax.shard_map(
+            functools.partial(
+                gpipe, toy_layer, axis_name="pp", n_stages=stages, n_micro=2
+            ),
+            mesh=mesh,
+            in_specs=(p_specs, P(None)),
+            out_specs=P(None),
+            check_vma=False,
+        )(stacked, x)
+        return (out * w).sum()
+
+    g_pp = jax.jit(jax.grad(pp_loss))(per_layer)
+    for a, e in zip(
+        jax.tree_util.tree_leaves(g_pp), jax.tree_util.tree_leaves(g_seq)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e), atol=2e-4)
+
+
+# --------------------------------------------------------------- model level
+
+
+def tiny_dalle(pp_axis=None, **kw):
+    return DALLE(
+        dim=32,
+        depth=4,
+        num_text_tokens=64,
+        text_seq_len=8,
+        num_image_tokens=32,
+        image_fmap_size=4,
+        heads=4,
+        dim_head=8,
+        attn_types=("full",),
+        pp_axis=pp_axis,
+        **kw,
+    )
+
+
+def test_dalle_pp_matches_single_device():
+    base = tiny_dalle(None)
+    pp_model = tiny_dalle("pp")
+    rng = np.random.RandomState(2)
+    text = jnp.asarray(rng.randint(1, 64, size=(4, 8)), jnp.int32)
+    image = jnp.asarray(rng.randint(0, 32, size=(4, 16)), jnp.int32)
+    params = base.init(jax.random.key(0), text, image)["params"]
+
+    l0, g0 = jax.jit(
+        jax.value_and_grad(
+            lambda p: base.apply({"params": p}, text, image, return_loss=True)
+        )
+    )(params)
+
+    runtime = make_runtime(dp=2, fsdp=1, tp=1, sp=1, pp=4)
+    with runtime.activate():
+        l1, g1 = jax.jit(
+            jax.value_and_grad(
+                lambda p: pp_model.apply({"params": p}, text, image, return_loss=True)
+            )
+        )(params)
+
+    np.testing.assert_allclose(float(l0), float(l1), rtol=2e-5)
+    for a, e in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g0)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), atol=5e-4, rtol=5e-3
+        )
+
+
+def test_dalle_pp_heterogeneous_layers_rejected():
+    model = tiny_dalle("pp").clone(attn_types=("full", "axial_row"))
+    rng = np.random.RandomState(3)
+    text = jnp.asarray(rng.randint(1, 64, size=(2, 8)), jnp.int32)
+    image = jnp.asarray(rng.randint(0, 32, size=(2, 16)), jnp.int32)
+    params = model.init(jax.random.key(0), text, image)["params"]
+    runtime = make_runtime(dp=2, fsdp=1, tp=1, sp=1, pp=4)
+    with runtime.activate():
+        with pytest.raises(ValueError, match="uniform attention type"):
+            model.apply({"params": params}, text, image, return_loss=True)
+
+
+def test_pp_train_step_end_to_end():
+    import optax
+
+    from dalle_pytorch_tpu.parallel import create_train_state, make_train_step
+
+    runtime = make_runtime(dp=2, fsdp=1, tp=1, sp=1, pp=4)
+    model = tiny_dalle("pp")
+    rng = np.random.RandomState(4)
+    batch = {
+        "text": jnp.asarray(rng.randint(1, 64, size=(4, 8)), jnp.int32),
+        "image": jnp.asarray(rng.randint(0, 32, size=(4, 16)), jnp.int32),
+    }
+    params = model.init(jax.random.key(0), batch["text"], batch["image"])["params"]
+    opt = optax.adam(1e-3)
+    state, shardings = create_train_state(params, opt, runtime)
+
+    def loss_fn(p, batch, rng):
+        return model.apply(
+            {"params": p}, batch["text"], batch["image"], return_loss=True
+        )
+
+    step = make_train_step(loss_fn, opt, runtime, shardings)
+    losses = []
+    for i in range(3):
+        state, loss = step(state, batch, jax.random.key(i))
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]

@@ -68,6 +68,14 @@ def parse_args():
     mesh_group.add_argument("--sp", type=int, default=1,
                             help="sequence/context parallel extent (ring + "
                                  "Ulysses attention over the sp mesh axis)")
+    mesh_group.add_argument("--pp", type=int, default=1,
+                            help="pipeline parallel extent (GPipe microbatch "
+                                 "schedule; needs uniform attn_types and "
+                                 "depth divisible by pp)")
+    mesh_group.add_argument("--pp_microbatches", type=int, default=4,
+                            help="GPipe microbatches per step (should divide "
+                                 "the per-data-shard batch; more microbatches "
+                                 "= smaller pipeline bubble)")
 
     train_group = parser.add_argument_group("Training settings")
     train_group.add_argument("--epochs", default=20, type=int)
@@ -157,7 +165,7 @@ def main():
     )
 
     init_distributed()
-    runtime = make_runtime(fsdp=args.fsdp, tp=args.tp, sp=args.sp)
+    runtime = make_runtime(fsdp=args.fsdp, tp=args.tp, sp=args.sp, pp=args.pp)
     runtime.check_batch_size(args.batch_size)
     tokenizer = pick_tokenizer(args)
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
@@ -180,11 +188,20 @@ def main():
         start_epoch = int(meta.get("epoch", -1)) + 1
         sched_state = meta.get("scheduler_state")
         assert vae is not None, "resume checkpoint carries no VAE"
-        # sequence parallelism is a runtime layout choice, not a model
-        # hyperparameter: follow this run's --sp, not the checkpoint's
+        # parallel layout is a runtime choice, not a model hyperparameter:
+        # follow this run's --sp/--pp, not the checkpoint's
         want_sp = "sp" if args.sp > 1 else None
-        if dalle.sp_axis != want_sp:
-            dalle = dalle.clone(sp_axis=want_sp)
+        want_pp = "pp" if args.pp > 1 else None
+        if (
+            dalle.sp_axis != want_sp
+            or dalle.pp_axis != want_pp
+            or dalle.pp_microbatches != args.pp_microbatches
+        ):
+            dalle = dalle.clone(
+                sp_axis=want_sp,
+                pp_axis=want_pp,
+                pp_microbatches=args.pp_microbatches,
+            )
     else:
         # VAE selection mirrors the reference (train_dalle.py:235-307):
         # --vae_path (self-trained) > --taming (VQGAN) > OpenAI dVAE default
@@ -223,6 +240,8 @@ def main():
             rotary_emb=args.rotary_emb,
             remat=args.remat,
             sp_axis="sp" if args.sp > 1 else None,
+            pp_axis="pp" if args.pp > 1 else None,
+            pp_microbatches=args.pp_microbatches,
             dtype=dtype,
         )
 
