@@ -72,6 +72,9 @@ class DALLE(nn.Module):
     sp_axis: Optional[str] = None
     pp_axis: Optional[str] = None
     pp_microbatches: int = 4
+    ff_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -150,6 +153,9 @@ class DALLE(nn.Module):
             sp_axis=self.sp_axis,
             pp_axis=self.pp_axis,
             pp_microbatches=self.pp_microbatches,
+            ff_experts=self.ff_experts,
+            moe_every=self.moe_every,
+            moe_capacity_factor=self.moe_capacity_factor,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )

@@ -27,6 +27,7 @@ from ..ops.layers import (
     PreNorm,
     PreShiftToken,
 )
+from ..ops.moe import MoEFeedForward
 from ..ops.reversible import reversible_forward_only, reversible_sequence
 from ..ops.rotary import angles, dalle_rotary_table, lang_freqs
 
@@ -76,6 +77,9 @@ class Transformer(nn.Module):
     sp_axis: Optional[str] = None
     pp_axis: Optional[str] = None
     pp_microbatches: int = 4
+    ff_experts: int = 0
+    moe_every: int = 2
+    moe_capacity_factor: float = 1.25
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -107,6 +111,13 @@ class Transformer(nn.Module):
                 "cannot run sequence-parallel; drop 'mlp' from attn_types "
                 "or disable sp"
             )
+        if self.ff_experts > 0 and (self.reversible or self.remat):
+            raise ValueError(
+                "MoE feed-forwards cannot run under reversible/remat "
+                "execution: those paths apply blocks through detached "
+                "closures where the Switch load-balance sow() is silently "
+                "dropped; use the sequential mode"
+            )
 
         attn_blocks, ff_blocks, kinds = [], [], []
         for ind in range(self.depth):
@@ -137,13 +148,26 @@ class Transformer(nn.Module):
                     dtype=self.dtype,
                     param_dtype=self.param_dtype,
                 )
-            ff = FeedForward(
-                dim=self.dim,
-                mult=self.ff_mult,
-                dropout=self.ff_dropout,
-                dtype=self.dtype,
-                param_dtype=self.param_dtype,
-            )
+            if self.ff_experts > 0 and ind % self.moe_every == self.moe_every - 1:
+                # GShard-style: every moe_every-th FF becomes a Switch-routed
+                # expert layer (ops/moe.py); experts shard over the ep axis
+                ff = MoEFeedForward(
+                    dim=self.dim,
+                    num_experts=self.ff_experts,
+                    mult=self.ff_mult,
+                    capacity_factor=self.moe_capacity_factor,
+                    dropout=self.ff_dropout,
+                    dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                )
+            else:
+                ff = FeedForward(
+                    dim=self.dim,
+                    mult=self.ff_mult,
+                    dropout=self.ff_dropout,
+                    dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                )
 
             if self.shift_tokens:
                 assert self.image_fmap_size is not None
@@ -268,6 +292,12 @@ class Transformer(nn.Module):
                 f"pipeline parallelism needs one uniform attention type "
                 f"(not mlp/sparse, whose layers are heterogeneous); got "
                 f"{self.attn_types}"
+            )
+        if self.ff_experts > 0:
+            raise ValueError(
+                "pipeline parallelism excludes MoE feed-forwards: the "
+                "dense/MoE layer alternation breaks stage homogeneity and "
+                "the load-balance sow() cannot cross the stage shard_map"
             )
         if self.reversible:
             raise ValueError("pipeline parallelism excludes reversible mode")

@@ -1,0 +1,101 @@
+"""Mixture-of-experts feed-forward with expert parallelism.
+
+The reference has no MoE (SURVEY.md §2.2 lists EP as n/a); this is a
+beyond-parity scaling axis in the GShard/Switch lineage, built so GSPMD can
+shard it over the ``ep`` mesh axis with zero manual collectives:
+
+- Switch-style top-1 routing: a linear gate scores experts per token; each
+  token goes to its argmax expert, weighted by the gate probability
+  (straight-through for the dropped experts' gradient via the prob weight);
+- capacity-based dispatch: each expert processes at most
+  ``capacity_factor * tokens / num_experts`` tokens per example; overflow
+  tokens fall through the residual (standard Switch behavior). Dispatch and
+  combine are one-hot einsums over a (tokens, experts, capacity) tensor —
+  the mesh-tensorflow formulation whose expert dimension GSPMD shards over
+  ``ep``, turning the einsums into all_to_all exchanges on ICI;
+- a load-balance auxiliary loss (mean routed fraction x mean gate prob per
+  expert, scaled by E — Switch eq. 4) is written to the mutable ``moe_aux``
+  collection; trainers add ``moe_aux_weight * sum(aux)`` to the objective
+  (train_dalle.py does when --moe_experts > 0);
+- expert weights are (E, ...) leaves; parallel/sharding.py's rules place
+  them as P("ep", ...), so each device stores and computes only its
+  experts.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.linen as nn
+
+Dtype = Any
+
+
+class MoEFeedForward(nn.Module):
+    """Switch-routed GEGLU feed-forward over ``num_experts`` experts."""
+
+    dim: int
+    num_experts: int
+    mult: float = 4.0
+    capacity_factor: float = 1.25
+    dropout: float = 0.0
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        b, n, d = x.shape
+        e = self.num_experts
+        hidden = int(self.dim * self.mult)
+        cap = max(int(self.capacity_factor * n / e), 1)
+
+        gate_logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32,
+            param_dtype=self.param_dtype, name="gate",
+        )(x.astype(jnp.float32))  # (b, n, e) — routing in f32 for stability
+        probs = jax.nn.softmax(gate_logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)  # (b, n)
+        expert_prob = jnp.take_along_axis(probs, expert_idx[..., None], axis=-1)[..., 0]
+
+        # position of each token within its expert's capacity buffer:
+        # running count of same-expert tokens before it (scan order = seq)
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)  # (b, n, e)
+        position = jnp.cumsum(onehot, axis=1) * onehot  # 1-based where routed
+        position = jnp.sum(position, axis=-1) - 1  # (b, n), -1 never happens
+        keep = position < cap  # overflow tokens fall through
+
+        # load-balance aux (Switch eq. 4): E * sum_e f_e * P_e
+        frac = jnp.mean(onehot.astype(jnp.float32), axis=(0, 1))  # (e,)
+        prob_mean = jnp.mean(probs, axis=(0, 1))
+        aux = e * jnp.sum(frac * prob_mean)
+        self.sow("moe_aux", "load_balance", aux)
+
+        # dispatch: (b, n, e, cap) one-hot; combine re-weights by gate prob
+        pos_oh = jax.nn.one_hot(
+            jnp.where(keep, position, cap), cap, dtype=x.dtype
+        )  # (b, n, cap); out-of-capacity rows are all-zero
+        dispatch = onehot.astype(x.dtype)[..., None] * pos_oh[:, :, None, :]
+        combine = dispatch * expert_prob[..., None, None].astype(x.dtype)
+
+        xs = jnp.einsum("bnec,bnd->ebcd", dispatch, x.astype(self.dtype))
+
+        w_in = self.param(
+            "experts_in", nn.initializers.lecun_normal(),
+            (e, d, hidden * 2), self.param_dtype,
+        )
+        w_out = self.param(
+            "experts_out", nn.initializers.lecun_normal(),
+            (e, hidden, d), self.param_dtype,
+        )
+        h = jnp.einsum(
+            "ebcd,edh->ebch", xs, w_in.astype(self.dtype)
+        )
+        h, gates = jnp.split(h, 2, axis=-1)
+        h = h * jax.nn.gelu(gates)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        ys = jnp.einsum("ebch,ehd->ebcd", h, w_out.astype(self.dtype))
+
+        return jnp.einsum("bnec,ebcd->bnd", combine, ys)

@@ -24,6 +24,7 @@ Axes:
   tp    tensor parallelism over attention heads / FF hidden (beyond-parity)
   sp    sequence/context parallelism (ring attention)
   pp    pipeline parallelism (GPipe microbatch schedule, parallel/pipeline.py)
+  ep    expert parallelism (Switch-routed MoE feed-forwards, ops/moe.py)
 """
 
 from __future__ import annotations
@@ -36,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXIS_NAMES = ("dp", "fsdp", "tp", "sp", "pp")
+AXIS_NAMES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
 
 
 def init_distributed(
@@ -176,6 +177,7 @@ def make_runtime(
     tp: int = 1,
     sp: int = 1,
     pp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> MeshRuntime:
     """Build a MeshRuntime over the available devices.
@@ -186,12 +188,14 @@ def make_runtime(
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    rest = fsdp * tp * sp * pp
-    assert n % rest == 0, f"{n} devices not divisible by fsdp*tp*sp*pp={rest}"
+    rest = fsdp * tp * sp * pp * ep
+    assert n % rest == 0, (
+        f"{n} devices not divisible by fsdp*tp*sp*pp*ep={rest}"
+    )
     if dp is None:
         dp = n // rest
     assert dp * rest == n, (
-        f"mesh {dp}x{fsdp}x{tp}x{sp}x{pp} != {n} available devices"
+        f"mesh {dp}x{fsdp}x{tp}x{sp}x{pp}x{ep} != {n} available devices"
     )
-    dev_array = np.asarray(devices).reshape(dp, fsdp, tp, sp, pp)
+    dev_array = np.asarray(devices).reshape(dp, fsdp, tp, sp, pp, ep)
     return MeshRuntime(mesh=Mesh(dev_array, AXIS_NAMES))

@@ -34,6 +34,10 @@ DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
     # GEGLU FF: up-projection splits hidden, down-projection splits input
     (r"FeedForward_\d+/Dense_0/kernel$", P("fsdp", "tp")),
     (r"FeedForward_\d+/Dense_1/kernel$", P("tp", "fsdp")),
+    # MoE experts: expert dim over ep, hidden over tp (ops/moe.py)
+    (r"experts_in$", P("ep", "fsdp", "tp")),
+    (r"experts_out$", P("ep", "tp", "fsdp")),
+    (r"gate/kernel$", P(None, None)),
     # gMLP
     (r"GMLPBlock_\d+/Dense_0/kernel$", P("fsdp", "tp")),
     (r"GMLPBlock_\d+/Dense_1/kernel$", P("tp", "fsdp")),

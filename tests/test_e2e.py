@@ -171,6 +171,54 @@ def trained_dalle(shapes_dataset, trained_vae, tmp_path_factory):
     return ckpt
 
 
+@pytest.mark.parametrize(
+    "mesh_flags, attn_types",
+    [
+        (["--sp", "2", "--tp", "2"], "full,axial_row"),
+        (["--pp", "2", "--pp_microbatches", "2"], "full"),
+    ],
+    ids=["sp2_tp2", "pp2"],
+)
+def test_train_cli_parallel_modes(shapes_dataset, trained_vae, tmp_path,
+                                  monkeypatch, mesh_flags, attn_types):
+    """train_dalle must run end-to-end with sequence parallelism (ring +
+    Ulysses) and pipeline parallelism (GPipe) over the virtual 8-device mesh
+    — the CLI analog of the model-level parity tests."""
+    import train_dalle
+    from dalle_pytorch_tpu.utils import MetricsLogger
+
+    out = tmp_path / "dalle_par"
+    losses = []
+    orig_log = MetricsLogger.log
+
+    def capture(self, logs, step=None):
+        if "loss" in logs:
+            losses.append(float(logs["loss"]))
+        return orig_log(self, logs, step=step)
+
+    argv = [
+        "--image_text_folder", str(shapes_dataset),
+        "--vae_path", str(trained_vae),
+        "--dim", "64",
+        "--depth", "2",
+        "--heads", "4",
+        "--dim_head", "16",
+        "--text_seq_len", "16",
+        "--batch_size", "8",
+        "--epochs", "2",
+        "--learning_rate", "1e-3",
+        "--truncate_captions",
+        "--attn_types", attn_types,
+        "--dalle_output_file_name", str(out),
+        *mesh_flags,
+    ]
+    monkeypatch.setattr(MetricsLogger, "log", capture)
+    monkeypatch.chdir(tmp_path)
+    _run_cli(monkeypatch, train_dalle, argv)
+    assert Path(f"{out}.ckpt").exists()
+    assert losses and all(np.isfinite(losses))
+
+
 def test_generate_cli_produces_images(trained_dalle, tmp_path):
     import generate
 

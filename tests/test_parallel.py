@@ -62,7 +62,9 @@ class TestMeshRuntime:
 
     def test_mixed_mesh_shapes(self):
         rt = make_runtime(fsdp=2, tp=2)
-        assert rt.mesh.shape == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1, "pp": 1}
+        assert rt.mesh.shape == {
+            "dp": 2, "fsdp": 2, "tp": 2, "sp": 1, "pp": 1, "ep": 1,
+        }
         assert rt.data_spec == P(("dp", "fsdp"))
 
     def test_bad_mesh_rejected(self):

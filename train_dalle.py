@@ -76,6 +76,19 @@ def parse_args():
                             help="GPipe microbatches per step (should divide "
                                  "the per-data-shard batch; more microbatches "
                                  "= smaller pipeline bubble)")
+    mesh_group.add_argument("--ep", type=int, default=1,
+                            help="expert parallel extent (shards MoE experts "
+                                 "over the ep mesh axis; use with "
+                                 "--moe_experts)")
+
+    moe_group = parser.add_argument_group("Mixture-of-experts settings")
+    moe_group.add_argument("--moe_experts", type=int, default=0,
+                           help="number of experts per MoE feed-forward "
+                                "(0 = dense FF everywhere)")
+    moe_group.add_argument("--moe_every", type=int, default=2,
+                           help="every n-th layer's FF becomes an MoE layer")
+    moe_group.add_argument("--moe_aux_weight", type=float, default=1e-2,
+                           help="weight of the Switch load-balance loss")
 
     train_group = parser.add_argument_group("Training settings")
     train_group.add_argument("--epochs", default=20, type=int)
@@ -165,7 +178,9 @@ def main():
     )
 
     init_distributed()
-    runtime = make_runtime(fsdp=args.fsdp, tp=args.tp, sp=args.sp, pp=args.pp)
+    runtime = make_runtime(
+        fsdp=args.fsdp, tp=args.tp, sp=args.sp, pp=args.pp, ep=args.ep
+    )
     runtime.check_batch_size(args.batch_size)
     tokenizer = pick_tokenizer(args)
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
@@ -242,6 +257,8 @@ def main():
             sp_axis="sp" if args.sp > 1 else None,
             pp_axis="pp" if args.pp > 1 else None,
             pp_microbatches=args.pp_microbatches,
+            ff_experts=args.moe_experts,
+            moe_every=args.moe_every,
             dtype=dtype,
         )
 
@@ -330,13 +347,24 @@ def main():
     )
 
     def loss_fn(p, batch, rng):
-        return dalle.apply(
-            {"params": p},
-            batch["text"],
-            batch["image"],
+        kwargs = dict(
             return_loss=True,
             deterministic=(args.attn_dropout == 0 and args.ff_dropout == 0),
             rngs={"dropout": rng},
+        )
+        # gate on the MODEL (a resumed checkpoint carries ff_experts even
+        # when --moe_experts was not re-specified)
+        if dalle.ff_experts > 0:
+            # MoE layers sow their Switch load-balance penalty into the
+            # mutable moe_aux collection (ops/moe.py)
+            loss, mut = dalle.apply(
+                {"params": p}, batch["text"], batch["image"],
+                mutable=["moe_aux"], **kwargs,
+            )
+            aux = sum(jax.tree_util.tree_leaves(mut["moe_aux"]))
+            return loss + args.moe_aux_weight * aux
+        return dalle.apply(
+            {"params": p}, batch["text"], batch["image"], **kwargs
         )
 
     step_fn = make_train_step(
