@@ -111,6 +111,11 @@ class Transformer(nn.Module):
                 "cannot run sequence-parallel; drop 'mlp' from attn_types "
                 "or disable sp"
             )
+        if self.ff_experts > 0 and self.moe_every <= 0:
+            raise ValueError(
+                f"moe_every must be >= 1 (every n-th FF becomes an expert "
+                f"layer); got {self.moe_every}"
+            )
         if self.ff_experts > 0 and (self.reversible or self.remat):
             raise ValueError(
                 "MoE feed-forwards cannot run under reversible/remat "

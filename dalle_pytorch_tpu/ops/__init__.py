@@ -1,5 +1,6 @@
 from . import masks, rotary
 from .attention import PatternAttention, dense_attend
+from .flash_attention import StaticMask, flash_attention
 from .layers import (
     FeedForward,
     GMLPBlock,
@@ -12,7 +13,9 @@ from .layers import (
     shift_tokens,
     stable_softmax,
 )
+from .moe import MoEFeedForward
 from .reversible import reversible_forward_only, reversible_sequence
+from .ring_attention import ring_attention, ulysses_attend
 from .rotary import apply_rotary_emb, dalle_rotary_table
 
 __all__ = [
@@ -20,6 +23,11 @@ __all__ = [
     "rotary",
     "PatternAttention",
     "dense_attend",
+    "StaticMask",
+    "flash_attention",
+    "MoEFeedForward",
+    "ring_attention",
+    "ulysses_attend",
     "FeedForward",
     "GMLPBlock",
     "LayerScale",

@@ -89,6 +89,9 @@ def parse_args():
                            help="every n-th layer's FF becomes an MoE layer")
     moe_group.add_argument("--moe_aux_weight", type=float, default=1e-2,
                            help="weight of the Switch load-balance loss")
+    moe_group.add_argument("--moe_capacity_factor", type=float, default=1.25,
+                           help="per-expert token capacity multiplier; "
+                                "overflow tokens fall through the residual")
 
     train_group = parser.add_argument_group("Training settings")
     train_group.add_argument("--epochs", default=20, type=int)
@@ -259,6 +262,7 @@ def main():
             pp_microbatches=args.pp_microbatches,
             ff_experts=args.moe_experts,
             moe_every=args.moe_every,
+            moe_capacity_factor=args.moe_capacity_factor,
             dtype=dtype,
         )
 
@@ -361,7 +365,8 @@ def main():
                 {"params": p}, batch["text"], batch["image"],
                 mutable=["moe_aux"], **kwargs,
             )
-            aux = sum(jax.tree_util.tree_leaves(mut["moe_aux"]))
+            # absent when no layer is actually MoE (e.g. moe_every > depth)
+            aux = sum(jax.tree_util.tree_leaves(mut.get("moe_aux", {})))
             return loss + args.moe_aux_weight * aux
         return dalle.apply(
             {"params": p}, batch["text"], batch["image"], **kwargs
