@@ -34,11 +34,21 @@ Dtype = Any
 NEG_INF = -0.7 * float(np.finfo(np.float32).max)
 
 
-def top_k_filter(logits: jnp.ndarray, thres: float = 0.5) -> jnp.ndarray:
+def top_k_filter(
+    logits: jnp.ndarray, thres: float = 0.5, k: Optional[int] = None
+) -> jnp.ndarray:
     """Keep the top ``max(int((1-thres)*vocab), 1)`` logits, fill the rest with
-    -inf (reference top_k, dalle_pytorch.py:50-56)."""
+    -inf (reference top_k, dalle_pytorch.py:50-56).
+
+    ``k`` overrides the fraction-derived count — callers that pre-slice the
+    logits to a live vocab segment pass the FULL-vocab-derived k so the
+    threshold matches the reference exactly; k >= width means no filtering
+    (and skips the top-k sort entirely)."""
     num_logits = logits.shape[-1]
-    k = max(int((1 - thres) * num_logits), 1)
+    if k is None:
+        k = max(int((1 - thres) * num_logits), 1)
+    if k >= num_logits:
+        return logits
     kth = jax.lax.top_k(logits, k)[0][..., -1:]
     return jnp.where(logits < kth, -jnp.inf, logits)
 

@@ -76,14 +76,32 @@ def decode_tokens(
 
     cache = init_decode_cache(dalle, params, b)
 
+    # after a full-text-prompt prefill, every sampled position is an image
+    # position whose text-vocab logits are masked (NEG_INF fill) — slicing to
+    # the live image segment samples the same distribution (masked entries
+    # rank below every real logit, so the full-vocab k gives the same
+    # threshold) and shrinks the per-token top-k sort from total_tokens to
+    # num_image_tokens wide; with the reference's fractional k it often
+    # disappears entirely (k >= image vocab => no filtering). Like prefill,
+    # this shifts the PRNG consumption (categorical draws over a narrower
+    # array), so sampled tokens for a given key differ from the full-vocab
+    # path while remaining distributionally identical.
+    image_only = prefill_len == text_len_internal
+    k_full = max(int((1 - filter_thres) * dalle.total_tokens), 1)
+
     def apply_sample(tokens, key, logits, i):
         """Sample the token at position i+1 from consumed-position-i logits
         (teacher-forced while i+1 < known_len)."""
         key, sub = jax.random.split(key)
-        filtered = top_k_filter(logits, thres=filter_thres)
+        filtered = (
+            top_k_filter(logits[:, ext:], k=k_full)
+            if image_only
+            else top_k_filter(logits, thres=filter_thres)
+        )
         sample = jax.random.categorical(sub, filtered / temperature, axis=-1)
         nxt = i + 1
-        sample = jnp.where(nxt >= text_len_internal, sample - ext, sample)
+        if not image_only:
+            sample = jnp.where(nxt >= text_len_internal, sample - ext, sample)
         prev = jax.lax.dynamic_slice_in_dim(tokens, nxt, 1, axis=1)[:, 0]
         new_val = jnp.where(nxt < known_len, prev, sample).astype(tokens.dtype)
         tokens = jax.lax.dynamic_update_slice(tokens, new_val[:, None], (0, nxt))
