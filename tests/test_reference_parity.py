@@ -1,13 +1,15 @@
-"""Byte-level parity against the REFERENCE tokenizer itself.
+"""Numeric and byte-level parity against the REFERENCE implementation itself.
 
-Loads the reference's SimpleTokenizer (/root/reference/dalle_pytorch/
-tokenizer.py, OpenAI's CLIP BPE) standalone — its unused yttm/ftfy imports
-stubbed — and checks that this framework's Python AND native C++ tokenizers
-produce identical ids and decodes. This is the strongest compatibility
-statement available in-environment: same vocab file, same ids, token for
-token. (The full reference package needs torch-ecosystem pips that are not
-installed, so model-level numeric parity is covered by our own oracles
-instead.)
+The full reference package needs torch-ecosystem pips that are not installed,
+but two of its modules load standalone (with their unused external imports
+stubbed), giving direct ground-truth oracles:
+
+- tokenizer.py: this framework's Python AND native C++ tokenizers must
+  produce identical ids and decodes — same vocab file, token for token;
+- attention.py (torch CPU): the dense-causal, conv-like-sparse and axial
+  attention modules must produce the same outputs as our ``PatternAttention``
+  when the projection weights are transplanted — semantics verified against
+  the reference's own einsums/masking, not just our internal oracles.
 """
 
 import importlib.machinery
@@ -27,24 +29,36 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+def _stub_module(name, **attrs):
+    """Install an import stub ONLY when the real module is absent (never
+    mutate an installed package), and leave installed modules untouched."""
+    if name in sys.modules:
+        mod = sys.modules[name]
+        if getattr(mod, "__stub__", False):
+            for k, v in attrs.items():
+                setattr(mod, k, v)
+        return mod
+    m = types.ModuleType(name)
+    m.__spec__ = importlib.machinery.ModuleSpec(name, loader=None)
+    m.__stub__ = True
+    for k, v in attrs.items():
+        setattr(m, k, v)
+    sys.modules[name] = m
+    return m
+
+
 @pytest.fixture(scope="module")
 def ref_tokenizer():
     """The reference SimpleTokenizer, with its module-level yttm/ftfy
-    imports stubbed (neither is installed; ftfy's fix_text is stubbed to the
-    same NFC normalization our no-ftfy fallback uses, so both pipelines
-    clean text identically)."""
-
-    def stub(name):
-        if name in sys.modules:
-            return sys.modules[name]
-        m = types.ModuleType(name)
-        m.__spec__ = importlib.machinery.ModuleSpec(name, loader=None)
-        sys.modules[name] = m
-        return m
-
-    stub("youtokentome")
-    ftfy = stub("ftfy")
-    ftfy.fix_text = lambda s: unicodedata.normalize("NFC", s)
+    imports stubbed (neither is installed in this image; ftfy's fix_text is
+    stubbed to the same NFC normalization our no-ftfy fallback uses, so both
+    pipelines clean text identically). If a real ftfy ever IS installed, the
+    stub helper leaves it alone and this parity would then compare real-ftfy
+    cleaning on both sides."""
+    if "ftfy" in sys.modules and not getattr(sys.modules["ftfy"], "__stub__", False):
+        pytest.skip("real ftfy installed; NFC-stub parity setup not applicable")
+    _stub_module("youtokentome")
+    _stub_module("ftfy", fix_text=lambda s: unicodedata.normalize("NFC", s))
 
     spec = importlib.util.spec_from_file_location("ref_tokenizer", REF_TOKENIZER)
     mod = importlib.util.module_from_spec(spec)
@@ -118,6 +132,137 @@ def test_tokenize_contract_matches_reference(ref_tokenizer, ours):
         ours.tokenize(["word " * 200], context_length=8)
     with pytest.raises(RuntimeError):
         ref_tokenizer.tokenize(["word " * 200], context_length=8)
+
+
+class TestAttentionParity:
+    """Transplant reference attention weights into PatternAttention and
+    require matching outputs (reference attention.py:39-321)."""
+
+    @pytest.fixture(scope="class")
+    def ref_attention_mod(self):
+        torch = pytest.importorskip("torch")
+
+        # never invoked in these tests (no rotary embeddings passed)
+        _stub_module("rotary_embedding_torch", apply_rotary_emb=lambda f, t: t)
+        spec = importlib.util.spec_from_file_location(
+            "ref_attention", "/root/reference/dalle_pytorch/attention.py"
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _compare(self, ref_mod_cls, our_kwargs, seq_len, n, ref_kwargs=None,
+                 with_mask=False, atol=2e-4, internal_plus_one=False):
+        """``internal_plus_one``: the reference sparse classes treat their
+        internal pattern length as seq_len + 1 (bos included — they compute
+        text_len = seq_len + 1 - img_seq, attention.py:116) and our
+        Transformer mirrors that by building PatternAttention with
+        seq_len + 1 (models/transformer.py:_attn_seq_len)."""
+        import jax.numpy as jnp
+        import torch
+
+        from dalle_pytorch_tpu.ops.attention import PatternAttention
+
+        dim, heads, dim_head = 32, 2, 8
+        torch.manual_seed(0)
+        ref = ref_mod_cls(
+            dim=dim, seq_len=seq_len, heads=heads, dim_head=dim_head,
+            **(ref_kwargs or {}),
+        ).eval()
+        our_seq_len = seq_len + 1 if internal_plus_one else seq_len
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, n, dim).astype(np.float32)
+        ref_mask = our_mask = None
+        if with_mask:
+            if internal_plus_one:
+                # the sparse classes consume a TEXT-ONLY padding mask
+                # (mask[:, :text_len], attention.py:123) and image keys are
+                # always visible; ours takes a full (b, n) key mask
+                text_len = our_seq_len - 16  # image_fmap 4
+                tm = rng.rand(2, text_len) > 0.3
+                tm[:, 0] = True
+                ref_mask = tm
+                our_mask = np.concatenate(
+                    [tm, np.ones((2, n - text_len), bool)], axis=1
+                )
+            else:
+                ref_mask = our_mask = (
+                    (rng.rand(2, n) > 0.3) | (np.arange(n)[None] == 0)
+                )
+
+        with torch.no_grad():
+            ref_out = ref(
+                torch.tensor(x),
+                mask=None if ref_mask is None else torch.tensor(ref_mask),
+            ).numpy()
+
+        params = {
+            "to_qkv": {"kernel": ref.to_qkv.weight.detach().numpy().T},
+            "to_out": {
+                "kernel": ref.to_out[0].weight.detach().numpy().T,
+                "bias": ref.to_out[0].bias.detach().numpy(),
+            },
+        }
+        ours = PatternAttention(
+            dim=dim, seq_len=our_seq_len, heads=heads, dim_head=dim_head,
+            use_flash=False, **our_kwargs,
+        )
+        out = ours.apply(
+            {"params": params}, jnp.asarray(x),
+            mask=None if our_mask is None else jnp.asarray(our_mask),
+        )
+        got = np.asarray(out)
+        if with_mask and internal_plus_one:
+            # reference quirk: the sparse classes apply the padding mask ONLY
+            # to image->text attention — their text self-attention ignores it
+            # entirely (attention.py:141-149 vs :185-188). Ours applies the
+            # key mask uniformly (the saner semantics; the path is vestigial
+            # since padding is handled by per-position pad tokens). Compare
+            # the image rows, where both implement the mask identically.
+            text_len = our_seq_len - 16
+            got, ref_out = got[:, text_len:], ref_out[:, text_len:]
+        np.testing.assert_allclose(got, ref_out, atol=atol)
+
+    @pytest.mark.parametrize("with_mask", [False, True])
+    def test_full_causal(self, ref_attention_mod, with_mask):
+        self._compare(
+            ref_attention_mod.Attention,
+            dict(attn_type="full", causal=True),
+            seq_len=24, n=24, ref_kwargs=dict(causal=True),
+            with_mask=with_mask,
+        )
+
+    def test_full_causal_stable_softmax(self, ref_attention_mod):
+        self._compare(
+            ref_attention_mod.Attention,
+            dict(attn_type="full", causal=True, stable=True),
+            seq_len=24, n=24, ref_kwargs=dict(causal=True, stable=True),
+        )
+
+    @pytest.mark.parametrize("n", [20, 18])
+    @pytest.mark.parametrize("with_mask", [False, True])
+    def test_conv_like(self, ref_attention_mod, n, with_mask):
+        """Conv-like window attention incl. a partially-generated image
+        (n < seq_len; the reference pads internally, attention.py:121-124)."""
+        self._compare(
+            ref_attention_mod.SparseConvCausalAttention,
+            dict(attn_type="conv_like", image_fmap_size=4, kernel_size=3),
+            seq_len=20, n=n,
+            ref_kwargs=dict(image_size=4, kernel_size=3),
+            with_mask=with_mask, internal_plus_one=True,
+        )
+
+    @pytest.mark.parametrize("axis, attn_type", [(0, "axial_row"), (1, "axial_col")])
+    @pytest.mark.parametrize("with_mask", [False, True])
+    def test_axial(self, ref_attention_mod, axis, attn_type, with_mask):
+        self._compare(
+            ref_attention_mod.SparseAxialCausalAttention,
+            dict(attn_type=attn_type, image_fmap_size=4),
+            seq_len=20, n=20,
+            ref_kwargs=dict(image_size=4, axis=axis),
+            with_mask=with_mask, internal_plus_one=True,
+        )
 
 
 def test_fuzz_against_reference(ref_tokenizer, ours):
