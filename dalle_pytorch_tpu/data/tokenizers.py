@@ -271,8 +271,6 @@ class YttmTokenizer(_TokenizeMixin):
             raise ImportError(
                 "YttmTokenizer requires the youtokentome package"
             ) from e
-        if not hasattr(yttm, "BPE"):  # an import stub, not the real package
-            raise ImportError("YttmTokenizer requires the youtokentome package")
         self.tokenizer = yttm.BPE(model=str(bpe_path))
         self.vocab_size = self.tokenizer.vocab_size()
 
