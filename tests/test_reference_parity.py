@@ -356,12 +356,18 @@ class TestDALLEModelParity:
 
         return _il.import_module("dalle_pytorch.dalle_pytorch")
 
-    def _transplant(self, sd, depth, fmap, dim):
-        """Reference state dict (numpy) -> our DALLE param tree."""
+    def _transplant(self, sd, depth, fmap, dim, reversible=False):
+        """Reference state dict (numpy) -> our DALLE param tree. The same
+        mapping carries gradients (same shapes, linear transforms)."""
         T = lambda a: np.ascontiguousarray(a.T)
 
         def layer(i):
-            a, f = f"transformer.layers.layers.{i}.0", f"transformer.layers.layers.{i}.1"
+            if reversible:  # ReversibleSequence wraps blocks as f/g streams
+                a = f"transformer.layers.blocks.{i}.f.net"
+                f = f"transformer.layers.blocks.{i}.g.net"
+            else:
+                a = f"transformer.layers.layers.{i}.0"
+                f = f"transformer.layers.layers.{i}.1"
             attn = {
                 "scale": sd[f"{a}.scale"].reshape(-1),
                 "fn": {
@@ -424,9 +430,17 @@ class TestDALLEModelParity:
         }
 
     @pytest.mark.parametrize(
-        "attn_types", [("full",), ("full", "axial_row"), ("conv_like", "axial_col")]
+        "attn_types, reversible",
+        [
+            (("full",), False),
+            (("full", "axial_row"), False),
+            (("conv_like", "axial_col"), False),
+            (("full", "axial_row"), True),
+        ],
     )
-    def test_full_model_logits_and_loss(self, ref_dalle_mod, attn_types):
+    def test_full_model_logits_loss_and_grads(
+        self, ref_dalle_mod, attn_types, reversible
+    ):
         import jax
         import jax.numpy as jnp
         import torch
@@ -449,11 +463,15 @@ class TestDALLEModelParity:
                 raise AssertionError("tokens are passed directly")
 
         torch.manual_seed(0)
+        # train mode (all dropout is 0, so outputs are unaffected): the
+        # reference's reversible Deterministic wrapper only records the RNG
+        # state it replays in backward when module.training is set
+        # (reversible.py:36-47)
         ref = ref_dalle_mod.DALLE(
             dim=dim, vae=FakeVAE(), num_text_tokens=n_text, text_seq_len=text_seq,
             depth=depth, heads=heads, dim_head=dim_head, attn_types=attn_types,
-            rotary_emb=False, shift_tokens=True,
-        ).eval()
+            rotary_emb=False, shift_tokens=True, reversible=reversible,
+        ).train()
 
         rng = np.random.RandomState(0)
         text_np = rng.randint(1, n_text, size=(2, text_seq))
@@ -464,27 +482,29 @@ class TestDALLEModelParity:
 
         with torch.no_grad():
             ref_logits = ref(text_t, image=image_t).numpy()
-            ref_loss = float(ref(text_t, image=image_t, return_loss=True))
+        ref_loss_t = ref(text_t, image=image_t, return_loss=True)
+        ref_loss_t.backward()  # reference gradients for the parity below
+        ref_loss = float(ref_loss_t.detach())
 
         sd = {
             k: v.detach().numpy()
             for k, v in ref.state_dict().items()
             if not k.startswith("vae.")
         }
-        params = self._transplant(sd, depth, fmap, dim)
+        params = self._transplant(sd, depth, fmap, dim, reversible=reversible)
 
         ours = DALLE(
             dim=dim, depth=depth, num_text_tokens=n_text, text_seq_len=text_seq,
             num_image_tokens=n_image, image_fmap_size=fmap, heads=heads,
             dim_head=dim_head, attn_types=attn_types, rotary_emb=False,
-            shift_tokens=True, use_flash=False,
+            shift_tokens=True, use_flash=False, reversible=reversible,
         )
         text_j = jnp.asarray(text_np, jnp.int32)
         image_j = jnp.asarray(image_np, jnp.int32)
         our_logits = np.asarray(ours.apply({"params": params}, text_j, image_j))
-        our_loss = float(
-            ours.apply({"params": params}, text_j, image_j, return_loss=True)
-        )
+        our_loss, our_grads = jax.value_and_grad(
+            lambda p: ours.apply({"params": p}, text_j, image_j, return_loss=True)
+        )(jax.tree_util.tree_map(jnp.asarray, params))
 
         # masked entries use different fill values (-finfo.max vs our
         # NEG_INF); compare the live entries and the loss
@@ -494,7 +514,27 @@ class TestDALLEModelParity:
             ref_logits[np.broadcast_to(live, ref_logits.shape)],
             atol=3e-4,
         )
-        np.testing.assert_allclose(our_loss, ref_loss, atol=1e-4)
+        np.testing.assert_allclose(float(our_loss), ref_loss, atol=1e-4)
+
+        # FULL gradient parity: the reference .grad tensors form a tree with
+        # the same shapes as the weights, so the same transplant mapping
+        # carries them into our param layout for leaf-by-leaf comparison
+        ref_grads_sd = {
+            k: (p.grad.detach().numpy() if p.grad is not None else None)
+            for k, p in ref.named_parameters()
+            if not k.startswith("vae.")
+        }
+        assert all(g is not None for g in ref_grads_sd.values())
+        ref_grads = self._transplant(ref_grads_sd, depth, fmap, dim, reversible=reversible)
+        flat_ours = jax.tree_util.tree_leaves_with_path(our_grads)
+        flat_ref = jax.tree_util.tree_leaves_with_path(ref_grads)
+        assert len(flat_ours) == len(flat_ref)
+        for (pa, a), (pb, b) in zip(flat_ours, flat_ref):
+            assert pa == pb
+            np.testing.assert_allclose(
+                np.asarray(a), b, atol=2e-4,
+                err_msg=f"gradient mismatch at {jax.tree_util.keystr(pa)}",
+            )
 
 
 def test_fuzz_against_reference(ref_tokenizer, ours):
