@@ -36,9 +36,11 @@ class CLIP(nn.Module):
     text_enc_depth: int = 6
     text_seq_len: int = 256
     text_heads: int = 8
+    text_dim_head: int = 64
     num_visual_tokens: int = 512
     visual_enc_depth: int = 6
     visual_heads: int = 8
+    visual_dim_head: int = 64
     visual_image_size: int = 256
     visual_patch_size: int = 32
     channels: int = 3
@@ -61,7 +63,9 @@ class CLIP(nn.Module):
             seq_len=self.text_seq_len,
             causal=False,
             heads=self.text_heads,
-            dim_head=self.dim_text // self.text_heads,
+            # the reference's CLIP transformers always use dim_head=64 (the
+            # Transformer default; dalle_pytorch.py:250,260 pass heads only)
+            dim_head=self.text_dim_head,
             rotary_emb=False,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -82,7 +86,7 @@ class CLIP(nn.Module):
             seq_len=self.num_patches,
             causal=False,
             heads=self.visual_heads,
-            dim_head=self.dim_image // self.visual_heads,
+            dim_head=self.visual_dim_head,
             rotary_emb=False,
             dtype=self.dtype,
             param_dtype=self.param_dtype,

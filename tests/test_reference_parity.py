@@ -292,10 +292,10 @@ class TestAttentionParity:
         )
 
 
-class TestDALLEModelParity:
-    """Full-model parity: load the reference DALLE (torch CPU) with its
-    unavailable externals stubbed, transplant EVERY weight into our DALLE,
-    and require the same logits and the same weighted split CE loss.
+@pytest.fixture(scope="module")
+def ref_dalle_mod(stub_scope):
+    """The reference dalle_pytorch.dalle_pytorch module (DALLE + CLIP),
+    loaded as a package with its unavailable externals stubbed.
 
     Stub notes: dalle_pytorch.vae is replaced (its module-level taming/
     omegaconf imports are not installed; the VAE is unused when image token
@@ -303,14 +303,14 @@ class TestDALLEModelParity:
     (rotary_emb=False, no 'mlp' layers), and axial_positional_embedding is
     re-implemented with lucidrains' summed-axial semantics — image position
     embeddings are therefore parity-by-construction while everything else
-    (embeddings, pad-token remap, token shift, LayerScale/PreNorm stacking,
-    attention, GEGLU FF, final norm, logits head, logits mask, loss
-    weighting) is genuinely cross-checked."""
+    is genuinely cross-checked."""
+    torch = pytest.importorskip("torch")
+    from torch import nn
 
-    @pytest.fixture(scope="class")
-    def ref_dalle_mod(self, stub_scope):
-        torch = pytest.importorskip("torch")
-        from torch import nn
+    return _load_ref_dalle(stub_scope, torch, nn)
+
+
+def _load_ref_dalle(stub_scope, torch, nn):
 
         class AxialPositionalEmbedding(nn.Module):
             def __init__(self, dim, axial_shape, axial_dims=None):
@@ -356,6 +356,61 @@ class TestDALLEModelParity:
 
         return _il.import_module("dalle_pytorch.dalle_pytorch")
 
+
+def _ref_layer_pair(sd, a, f, shifted):
+    """Map one reference (attn, ff) layer pair into our param subtrees; the
+    same mapping carries gradients (pure reindexing). ``shifted``: DALLE's
+    transformer wraps blocks in PreShiftToken (one extra fn level on both
+    sides); CLIP's does not."""
+    T = lambda x: np.ascontiguousarray(x.T)
+    mid = ".fn.fn.fn" if shifted else ".fn.fn"
+
+    def wrap(inner):
+        return {"fn": inner} if shifted else inner
+
+    attn = {
+        "scale": sd[f"{a}.scale"].reshape(-1),
+        "fn": {
+            "LayerNorm_0": {
+                "scale": sd[f"{a}.fn.norm.weight"],
+                "bias": sd[f"{a}.fn.norm.bias"],
+            },
+            "fn": wrap({
+                "to_qkv": {"kernel": T(sd[f"{a}{mid}.to_qkv.weight"])},
+                "to_out": {
+                    "kernel": T(sd[f"{a}{mid}.to_out.0.weight"]),
+                    "bias": sd[f"{a}{mid}.to_out.0.bias"],
+                },
+            }),
+        },
+    }
+    ff = {
+        "scale": sd[f"{f}.scale"].reshape(-1),
+        "fn": {
+            "LayerNorm_0": {
+                "scale": sd[f"{f}.fn.norm.weight"],
+                "bias": sd[f"{f}.fn.norm.bias"],
+            },
+            "fn": wrap({
+                "Dense_0": {
+                    "kernel": T(sd[f"{f}{mid}.net.0.weight"]),
+                    "bias": sd[f"{f}{mid}.net.0.bias"],
+                },
+                "Dense_1": {
+                    "kernel": T(sd[f"{f}{mid}.net.3.weight"]),
+                    "bias": sd[f"{f}{mid}.net.3.bias"],
+                },
+            }),
+        },
+    }
+    return attn, ff
+
+
+class TestDALLEModelParity:
+    """Full-model parity: load the reference DALLE (torch CPU), transplant
+    EVERY weight into our DALLE, and require the same logits, loss, and
+    gradients (see the ref_dalle_mod fixture for the stub notes)."""
+
     def _transplant(self, sd, depth, fmap, dim, reversible=False):
         """Reference state dict (numpy) -> our DALLE param tree. The same
         mapping carries gradients (same shapes, linear transforms)."""
@@ -368,42 +423,7 @@ class TestDALLEModelParity:
             else:
                 a = f"transformer.layers.layers.{i}.0"
                 f = f"transformer.layers.layers.{i}.1"
-            attn = {
-                "scale": sd[f"{a}.scale"].reshape(-1),
-                "fn": {
-                    "LayerNorm_0": {
-                        "scale": sd[f"{a}.fn.norm.weight"],
-                        "bias": sd[f"{a}.fn.norm.bias"],
-                    },
-                    "fn": {"fn": {
-                        "to_qkv": {"kernel": T(sd[f"{a}.fn.fn.fn.to_qkv.weight"])},
-                        "to_out": {
-                            "kernel": T(sd[f"{a}.fn.fn.fn.to_out.0.weight"]),
-                            "bias": sd[f"{a}.fn.fn.fn.to_out.0.bias"],
-                        },
-                    }},
-                },
-            }
-            ff = {
-                "scale": sd[f"{f}.scale"].reshape(-1),
-                "fn": {
-                    "LayerNorm_0": {
-                        "scale": sd[f"{f}.fn.norm.weight"],
-                        "bias": sd[f"{f}.fn.norm.bias"],
-                    },
-                    "fn": {"fn": {
-                        "Dense_0": {
-                            "kernel": T(sd[f"{f}.fn.fn.fn.net.0.weight"]),
-                            "bias": sd[f"{f}.fn.fn.fn.net.0.bias"],
-                        },
-                        "Dense_1": {
-                            "kernel": T(sd[f"{f}.fn.fn.fn.net.3.weight"]),
-                            "bias": sd[f"{f}.fn.fn.fn.net.3.bias"],
-                        },
-                    }},
-                },
-            }
-            return attn, ff
+            return _ref_layer_pair(sd, a, f, shifted=True)
 
         transformer = {}
         for i in range(depth):
@@ -535,6 +555,80 @@ class TestDALLEModelParity:
                 np.asarray(a), b, atol=2e-4,
                 err_msg=f"gradient mismatch at {jax.tree_util.keystr(pa)}",
             )
+
+
+class TestCLIPParity:
+    """Reference CLIP (dalle_pytorch.py:229-305) vs ours with transplanted
+    weights: similarity scores, contrastive loss, masked-mean pooling."""
+
+    @pytest.mark.parametrize("with_mask", [False, True])
+    def test_similarity_and_loss(self, ref_dalle_mod, with_mask):
+        import jax.numpy as jnp
+        import torch
+
+        from dalle_pytorch_tpu.models import CLIP
+
+        kw = dict(dim_text=32, dim_image=32, dim_latent=16, num_text_tokens=50,
+                  text_enc_depth=2, text_seq_len=8, text_heads=2,
+                  visual_enc_depth=2, visual_heads=2, visual_image_size=16,
+                  visual_patch_size=8)
+        torch.manual_seed(0)
+        ref = ref_dalle_mod.CLIP(**kw).eval()
+
+        rng = np.random.RandomState(0)
+        text_np = rng.randint(0, 50, size=(3, 8))
+        img_np = rng.rand(3, 3, 16, 16).astype(np.float32)  # NCHW for torch
+        mask_np = (rng.rand(3, 8) > 0.3) if with_mask else None
+        if mask_np is not None:
+            mask_np[:, 0] = True
+
+        t_text = torch.tensor(text_np, dtype=torch.long)
+        t_img = torch.tensor(img_np)
+        t_mask = None if mask_np is None else torch.tensor(mask_np)
+        with torch.no_grad():
+            ref_sim = ref(t_text, t_img, text_mask=t_mask).numpy()
+            ref_loss = float(ref(t_text, t_img, text_mask=t_mask, return_loss=True))
+
+        sd = {k: v.detach().numpy() for k, v in ref.state_dict().items()}
+        T = lambda a: np.ascontiguousarray(a.T)
+        text_tf, visual_tf = {}, {}
+        for i in range(2):
+            for tf, prefix in ((text_tf, "text_transformer"),
+                               (visual_tf, "visual_transformer")):
+                a, f = _ref_layer_pair(
+                    sd, f"{prefix}.layers.layers.{i}.0",
+                    f"{prefix}.layers.layers.{i}.1", shifted=False,
+                )
+                tf[f"attn_{i}"], tf[f"ff_{i}"] = a, f
+        params = {
+            "text_emb": {"embedding": sd["text_emb.weight"]},
+            "text_pos_emb": {"embedding": sd["text_pos_emb.weight"]},
+            "text_transformer": text_tf,
+            "to_text_latent": {"kernel": T(sd["to_text_latent.weight"])},
+            "to_visual_embedding": {
+                "kernel": T(sd["to_visual_embedding.weight"]),
+                "bias": sd["to_visual_embedding.bias"],
+            },
+            "visual_pos_emb": {"embedding": sd["visual_pos_emb.weight"]},
+            "visual_transformer": visual_tf,
+            "to_visual_latent": {"kernel": T(sd["to_visual_latent.weight"])},
+            "temperature": sd["temperature"],
+        }
+
+        ours = CLIP(**kw)
+        j_img = jnp.asarray(np.transpose(img_np, (0, 2, 3, 1)))  # NHWC here
+        j_mask = None if mask_np is None else jnp.asarray(mask_np)
+        our_sim = np.asarray(
+            ours.apply({"params": params}, jnp.asarray(text_np), j_img, j_mask)
+        )
+        our_loss = float(
+            ours.apply(
+                {"params": params}, jnp.asarray(text_np), j_img, j_mask,
+                return_loss=True,
+            )
+        )
+        np.testing.assert_allclose(our_sim, ref_sim, atol=2e-4)
+        np.testing.assert_allclose(our_loss, ref_loss, atol=1e-4)
 
 
 def test_fuzz_against_reference(ref_tokenizer, ours):
