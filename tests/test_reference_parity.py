@@ -357,12 +357,25 @@ def _load_ref_dalle(stub_scope, torch, nn):
         return _il.import_module("dalle_pytorch.dalle_pytorch")
 
 
+def _T(a):
+    """Torch Linear/Conv kernel -> flax layout transpose."""
+    return np.ascontiguousarray(a.T)
+
+
+def _np_state_dict(mod, skip_prefix=None):
+    return {
+        k: v.detach().numpy()
+        for k, v in mod.state_dict().items()
+        if skip_prefix is None or not k.startswith(skip_prefix)
+    }
+
+
 def _ref_layer_pair(sd, a, f, shifted):
     """Map one reference (attn, ff) layer pair into our param subtrees; the
     same mapping carries gradients (pure reindexing). ``shifted``: DALLE's
     transformer wraps blocks in PreShiftToken (one extra fn level on both
     sides); CLIP's does not."""
-    T = lambda x: np.ascontiguousarray(x.T)
+    T = _T
     mid = ".fn.fn.fn" if shifted else ".fn.fn"
 
     def wrap(inner):
@@ -414,7 +427,7 @@ class TestDALLEModelParity:
     def _transplant(self, sd, depth, fmap, dim, reversible=False):
         """Reference state dict (numpy) -> our DALLE param tree. The same
         mapping carries gradients (same shapes, linear transforms)."""
-        T = lambda a: np.ascontiguousarray(a.T)
+        T = _T
 
         def layer(i):
             if reversible:  # ReversibleSequence wraps blocks as f/g streams
@@ -506,11 +519,7 @@ class TestDALLEModelParity:
         ref_loss_t.backward()  # reference gradients for the parity below
         ref_loss = float(ref_loss_t.detach())
 
-        sd = {
-            k: v.detach().numpy()
-            for k, v in ref.state_dict().items()
-            if not k.startswith("vae.")
-        }
+        sd = _np_state_dict(ref, skip_prefix="vae.")
         params = self._transplant(sd, depth, fmap, dim, reversible=reversible)
 
         ours = DALLE(
@@ -589,8 +598,8 @@ class TestCLIPParity:
             ref_sim = ref(t_text, t_img, text_mask=t_mask).numpy()
             ref_loss = float(ref(t_text, t_img, text_mask=t_mask, return_loss=True))
 
-        sd = {k: v.detach().numpy() for k, v in ref.state_dict().items()}
-        T = lambda a: np.ascontiguousarray(a.T)
+        sd = _np_state_dict(ref)
+        T = _T
         text_tf, visual_tf = {}, {}
         for i in range(2):
             for tf, prefix in ((text_tf, "text_transformer"),
@@ -629,6 +638,101 @@ class TestCLIPParity:
         )
         np.testing.assert_allclose(our_sim, ref_sim, atol=2e-4)
         np.testing.assert_allclose(our_loss, ref_loss, atol=1e-4)
+
+
+class TestDiscreteVAEParity:
+    """Reference DiscreteVAE (dalle_pytorch.py:74-225) vs ours with
+    transplanted conv stacks: the deterministic paths — encoder logits /
+    codebook indices and decode — must match (the gumbel-sampled training
+    forward is stochastic and is pinned by our own KL/recon tests)."""
+
+    def _transplant(self, sd, num_layers, num_res):
+        def conv(prefix):
+            return {
+                "kernel": np.ascontiguousarray(
+                    np.transpose(sd[f"{prefix}.weight"], (2, 3, 1, 0))
+                ),
+                "bias": sd[f"{prefix}.bias"],
+            }
+
+        def convT(prefix):
+            # torch ConvTranspose2d weight is (in, out, H, W) and applies the
+            # kernel SPATIALLY FLIPPED relative to flax's ConvTranspose
+            # (fractionally-strided correlation): transpose to (H, W, in,
+            # out) then flip both spatial dims (verified: unflipped diverges
+            # ~5e-2, flipped matches to ~3e-4)
+            k = np.transpose(sd[f"{prefix}.weight"], (2, 3, 0, 1))
+            return {
+                "kernel": np.ascontiguousarray(k[::-1, ::-1]),
+                "bias": sd[f"{prefix}.bias"],
+            }
+
+        def res(prefix):
+            return {
+                "Conv_0": conv(f"{prefix}.net.0"),
+                "Conv_1": conv(f"{prefix}.net.2"),
+                "Conv_2": conv(f"{prefix}.net.4"),
+            }
+
+        p = {"codebook": {"embedding": sd["codebook.weight"]}}
+        for i in range(num_layers):
+            p[f"enc_convs_{i}"] = conv(f"encoder.{i}.0")
+            p[f"dec_convs_{i}"] = convT(f"decoder.{1 + num_res + i}.0")
+        for j in range(num_res):
+            p[f"enc_res_{j}"] = res(f"encoder.{num_layers + j}")
+            p[f"dec_res_{j}"] = res(f"decoder.{1 + j}")
+        p["enc_out"] = conv(f"encoder.{num_layers + num_res}")
+        p["dec_in"] = conv("decoder.0")
+        p["dec_out"] = conv(f"decoder.{1 + num_res + num_layers}")
+        return p
+
+    def test_encode_decode_match(self, ref_dalle_mod):
+        import jax.numpy as jnp
+        import torch
+
+        from dalle_pytorch_tpu.models import DiscreteVAE
+
+        kw = dict(image_size=16, num_tokens=24, codebook_dim=20, num_layers=2,
+                  num_resnet_blocks=1, hidden_dim=12)
+        torch.manual_seed(0)
+        ref = ref_dalle_mod.DiscreteVAE(**kw).eval()
+
+        rng = np.random.RandomState(0)
+        img_np = rng.rand(2, 3, 16, 16).astype(np.float32)  # NCHW
+        t_img = torch.tensor(img_np)
+        with torch.no_grad():
+            ref_logits = ref(t_img, return_logits=True).numpy()  # (b, T, h, w)
+            ref_idx = ref.get_codebook_indices(t_img).numpy()
+            ref_dec = ref.decode(torch.tensor(ref_idx)).numpy()  # NCHW
+
+        sd = _np_state_dict(ref)
+        params = self._transplant(sd, num_layers=2, num_res=1)
+        ours = DiscreteVAE(**kw)
+
+        j_img = jnp.asarray(np.transpose(img_np, (0, 2, 3, 1)))  # NHWC here
+        our_idx = np.asarray(
+            ours.apply({"params": params}, j_img,
+                       method=DiscreteVAE.get_codebook_indices)
+        )
+        our_logits = np.asarray(
+            ours.apply({"params": params}, j_img, return_logits=True)
+        )  # NHWC: (b, h, w, T)
+        our_dec = np.asarray(
+            ours.apply({"params": params}, jnp.asarray(ref_idx),
+                       method=DiscreteVAE.decode)
+        )  # NHWC
+
+        np.testing.assert_allclose(
+            our_logits, np.transpose(ref_logits, (0, 2, 3, 1)), atol=2e-4
+        )
+        # indices come from argmax over identical logits; identical up to
+        # float ties, which random weights make measure-zero
+        np.testing.assert_array_equal(
+            our_idx, ref_idx.reshape(our_idx.shape)
+        )
+        np.testing.assert_allclose(
+            our_dec, np.transpose(ref_dec, (0, 2, 3, 1)), atol=2e-4
+        )
 
 
 def test_fuzz_against_reference(ref_tokenizer, ours):
