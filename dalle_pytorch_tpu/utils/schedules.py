@@ -38,18 +38,22 @@ class ReduceLROnPlateau:
         self.cooldown_counter = 0
 
     def step(self, metric: float) -> float:
+        # torch's exact step order (lr_scheduler.ReduceLROnPlateau.step):
+        # improvement test, THEN the cooldown decrement (which runs on every
+        # in-cooldown step — including improving ones — and zeroes the bad
+        # count), then the reduction check
         if metric < self.best * (1 - self.threshold):
             self.best = metric
             self.num_bad = 0
-        elif self.cooldown_counter > 0:
-            self.cooldown_counter -= 1
-            self.num_bad = 0
         else:
             self.num_bad += 1
-            if self.num_bad > self.patience:
-                self.lr = max(self.lr * self.factor, self.min_lr)
-                self.cooldown_counter = self.cooldown
-                self.num_bad = 0
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.num_bad = 0
+        if self.num_bad > self.patience:
+            self.lr = max(self.lr * self.factor, self.min_lr)
+            self.cooldown_counter = self.cooldown
+            self.num_bad = 0
         return self.lr
 
     def state_dict(self) -> dict:

@@ -735,6 +735,62 @@ class TestDiscreteVAEParity:
         )
 
 
+class TestSchedulerParity:
+    """The reference drives torch's stateful schedulers
+    (train_dalle.py:429-441, train_vae.py:150-151); our host-side
+    controllers must trace the same lr trajectories."""
+
+    def test_reduce_lr_on_plateau_matches_torch(self):
+        torch = pytest.importorskip("torch")
+
+        from dalle_pytorch_tpu.utils import ReduceLROnPlateau
+
+        lr0 = 3e-4
+        kw = dict(factor=0.5, patience=3, cooldown=2, min_lr=1e-6,
+                  threshold=1e-4)
+        ours = ReduceLROnPlateau(lr0, **kw)
+        p = torch.nn.Parameter(torch.zeros(1))
+        opt = torch.optim.SGD([p], lr=lr0)
+        ref = torch.optim.lr_scheduler.ReduceLROnPlateau(
+            opt, mode="min", **kw
+        )
+
+        rng = np.random.RandomState(0)
+        # plateaus with improvement bursts, plus a hand-built prefix whose
+        # improvements land INSIDE a cooldown window (steps 5-6 fall in the
+        # cooldown opened by the step-4 reduction) — the case where torch
+        # decrements the cooldown counter on improving steps and a naive
+        # elif-ordered implementation diverges
+        metrics = [5.0] * 5 + [4.0, 3.0] + [3.0] * 8
+        level = 5.0
+        for seg in range(8):
+            if seg % 3 == 2:
+                level *= 0.7  # improvement burst
+            metrics += list(level + rng.rand(7) * 1e-6)
+        for i, m in enumerate(metrics):
+            our_lr = ours.step(float(m))
+            ref.step(float(m))
+            ref_lr = opt.param_groups[0]["lr"]
+            assert our_lr == pytest.approx(ref_lr, rel=1e-9), (
+                f"lr diverged at step {i}: ours {our_lr} vs torch {ref_lr}"
+            )
+
+    def test_exponential_decay_matches_torch(self):
+        torch = pytest.importorskip("torch")
+
+        from dalle_pytorch_tpu.utils import ExponentialDecay
+
+        lr0, gamma = 1e-3, 0.98
+        ours = ExponentialDecay(lr0, gamma=gamma)
+        p = torch.nn.Parameter(torch.zeros(1))
+        opt = torch.optim.SGD([p], lr=lr0)
+        ref = torch.optim.lr_scheduler.ExponentialLR(opt, gamma=gamma)
+        for i in range(25):
+            our_lr = ours.step()
+            ref.step()
+            assert our_lr == pytest.approx(opt.param_groups[0]["lr"], rel=1e-9)
+
+
 def test_fuzz_against_reference(ref_tokenizer, ours):
     rng = np.random.RandomState(7)
     pools = [
