@@ -92,11 +92,20 @@ class Transformer(nn.Module):
         if self.image_fmap_size is not None:
             img_seq_len = self.image_fmap_size**2
             text_len = self.seq_len - img_seq_len + 1
-            return dalle_rotary_table(self.dim_head, text_len, self.image_fmap_size)
-        # plain 1-D rotary fallback (no image grid present)
-        return angles(np.arange(self.seq_len), lang_freqs(self.dim_head // 2)).astype(
-            np.float32
-        )
+            table = dalle_rotary_table(self.dim_head, text_len, self.image_fmap_size)
+        else:
+            # plain 1-D rotary fallback (no image grid present)
+            table = angles(
+                np.arange(self.seq_len), lang_freqs(self.dim_head // 2)
+            ).astype(np.float32)
+        # zero-pad the angle table to the full head dim: zero angle = identity
+        # rotation for the channels the reference leaves untouched, and a
+        # full-width table lets apply_rotary_emb stay purely elementwise
+        # (measured ~6 ms/step of XLA layout copies at the flagship config)
+        pad = self.dim_head - table.shape[-1]
+        if pad > 0:
+            table = np.pad(table, ((0, 0), (0, pad)))
+        return table
 
     def setup(self):
         attn_types = cast_tuple(self.attn_types or ("full",))

@@ -15,6 +15,9 @@ inside the compiled step, so nothing in the hot path is data-dependent.
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -39,11 +42,33 @@ def angles(positions: np.ndarray, freqs: np.ndarray) -> np.ndarray:
     return np.repeat(a, 2, axis=-1).reshape(*positions.shape, -1)
 
 
+@functools.lru_cache(maxsize=None)
+def _rotate_half_matrix(d: int) -> np.ndarray:
+    """(d, d) signed-permutation matrix P with (x @ P) = rotate_half(x)."""
+    P = np.zeros((d, d), dtype=np.float32)
+    idx = np.arange(0, d, 2)
+    P[idx + 1, idx] = -1.0  # out[2i] = -x[2i+1]
+    P[idx, idx + 1] = 1.0   # out[2i+1] = x[2i]
+    return P
+
+
 def rotate_half(x: jnp.ndarray) -> jnp.ndarray:
-    """Per adjacent pair (x1, x2) -> (-x2, x1)."""
-    x = x.reshape(*x.shape[:-1], -1, 2)
-    x1, x2 = x[..., 0], x[..., 1]
-    return jnp.stack((-x2, x1), axis=-1).reshape(*x.shape[:-2], -1)
+    """Per adjacent pair (x1, x2) -> (-x2, x1).
+
+    Implemented as a tiny constant signed-permutation matmul rather than a
+    pair reshape/stack: each output element is exactly +-one finite input
+    element (every other product is exactly 0.0), so the result matches the
+    reshape formulation exactly — but the contraction runs over the
+    minor-most dim on the MXU and keeps the tensor's layout, where the
+    (d//2, 2) reshape forces XLA into n-minor layouts and several ms/step
+    of layout-conversion copies at the flagship config. Precision.HIGHEST
+    keeps f32 inputs exact (it is a no-op for bf16). Trade-off: a
+    non-finite input channel (inf/nan — training already diverged) spreads
+    NaN across its whole head-dim row via 0*inf, where the reshape kept it
+    in its own pair."""
+    assert x.shape[-1] % 2 == 0, f"rotate_half needs an even dim, got {x.shape[-1]}"
+    P = jnp.asarray(_rotate_half_matrix(x.shape[-1]), x.dtype)
+    return jnp.einsum("...i,ij->...j", x, P, precision=jax.lax.Precision.HIGHEST)
 
 
 def apply_rotary_emb(angle_table: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
@@ -54,8 +79,12 @@ def apply_rotary_emb(angle_table: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
     3 * (dim_head // 3 // 2 * 2) of every head's channels).
     """
     rot_dim = angle_table.shape[-1]
-    t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
     angle_table = angle_table.astype(t.dtype)
+    if rot_dim == t.shape[-1]:
+        # full-width table (zero-padded angles rotate by identity): pure
+        # elementwise — no slice/concat, so XLA emits no layout copies
+        return t * jnp.cos(angle_table) + rotate_half(t) * jnp.sin(angle_table)
+    t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
     t_rot = t_rot * jnp.cos(angle_table) + rotate_half(t_rot) * jnp.sin(angle_table)
     return jnp.concatenate((t_rot, t_pass), axis=-1)
 
