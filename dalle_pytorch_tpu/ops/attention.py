@@ -160,11 +160,16 @@ class PatternAttention(nn.Module):
             inner * 3, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype, name="to_qkv"
         )(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q, k, v = (t.reshape(b, n, h, d).transpose(0, 2, 1, 3) for t in (q, k, v))
 
         if decode:
+            # decode stays in (b, n, h, d) end to end: the K/V caches live
+            # n-major, so the cache-wide dots stream (L, h*d) rows and the
+            # per-step head transposes disappear entirely
+            q, k, v = (t.reshape(b, n, h, d) for t in (q, k, v))
             out = self._decode_attend(q, k, v, mask, rotary_pos_emb)
+            out = out.reshape(b, n, inner)
         else:
+            q, k, v = (t.reshape(b, n, h, d).transpose(0, 2, 1, 3) for t in (q, k, v))
             if rotary_pos_emb is not None:
                 table = rotary_pos_emb[:n][None, None]  # (1, 1, n, rot)
                 q, k, v = (apply_rotary_emb(table, t) for t in (q, k, v))
@@ -190,7 +195,7 @@ class PatternAttention(nn.Module):
                     q * (d**-0.5), k, v, mask, force_dense=force_dense
                 )
 
-        out = out.transpose(0, 2, 1, 3).reshape(b, -1, inner)
+            out = out.transpose(0, 2, 1, 3).reshape(b, -1, inner)
         out = nn.Dense(self.dim, dtype=self.dtype, param_dtype=self.param_dtype, name="to_out")(out)
         return nn.Dropout(self.dropout)(out, deterministic=deterministic)
 
@@ -429,21 +434,26 @@ class PatternAttention(nn.Module):
     # ------------------------------------------------------------ decode path
 
     def _decode_attend(self, q, k, v, mask, rotary_pos_emb):
-        """Decode against a (b, h, L, d) K/V cache: single-token steps or
-        multi-token prefill blocks (n > 1, e.g. the text prompt in one
-        parallel pass). Each new token's row of the pattern mask selects
+        """Decode against an n-major (b, L, h, d) K/V cache: single-token
+        steps or multi-token prefill blocks (n > 1, e.g. the text prompt in
+        one parallel pass). Each new token's row of the pattern mask selects
         which cached keys it sees, so attending against the full-length cache
         (zeros beyond the write index, always masked) matches sequential
-        decode exactly."""
-        b, h, n, d = q.shape
+        decode exactly. The cache keeps positions on the second-major axis so
+        the per-token cache-wide QK^T / AV sweeps scan (L, h*d) rows in the
+        projection's natural layout and decode needs no head transposes at
+        all. (The sweeps themselves are latency-bound on the serial
+        cache-update -> read dependency, not layout-bound: per-token cost
+        measured identical to the (b, h, L, d) variant.)"""
+        b, n, h, d = q.shape
         L = self.seq_len
 
         is_init = not self.has_variable("cache", "cached_key")
         cached_key = self.variable(
-            "cache", "cached_key", jnp.zeros, (b, h, L, d), k.dtype
+            "cache", "cached_key", jnp.zeros, (b, L, h, d), k.dtype
         )
         cached_value = self.variable(
-            "cache", "cached_value", jnp.zeros, (b, h, L, d), v.dtype
+            "cache", "cached_value", jnp.zeros, (b, L, h, d), v.dtype
         )
         cache_index = self.variable(
             "cache", "cache_index", lambda: jnp.array(0, dtype=jnp.int32)
@@ -453,12 +463,13 @@ class PatternAttention(nn.Module):
 
         idx = cache_index.value
         if rotary_pos_emb is not None:
-            rows = jax.lax.dynamic_slice_in_dim(rotary_pos_emb, idx, n, axis=0)[None, None]
+            rows = jax.lax.dynamic_slice_in_dim(rotary_pos_emb, idx, n, axis=0)
+            rows = rows[None, :, None, :]  # broadcast over (b, n, h, d)
             q, k, v = (apply_rotary_emb(rows, t) for t in (q, k, v))
         q = q * (d**-0.5)
 
-        cached_key.value = jax.lax.dynamic_update_slice_in_dim(cached_key.value, k, idx, axis=2)
-        cached_value.value = jax.lax.dynamic_update_slice_in_dim(cached_value.value, v, idx, axis=2)
+        cached_key.value = jax.lax.dynamic_update_slice_in_dim(cached_key.value, k, idx, axis=1)
+        cached_value.value = jax.lax.dynamic_update_slice_in_dim(cached_value.value, v, idx, axis=1)
         cache_index.value = idx + n
 
         allowed = jax.lax.dynamic_slice_in_dim(
@@ -466,4 +477,12 @@ class PatternAttention(nn.Module):
         )[None, None]  # (1, 1, n, L)
         if mask is not None:
             allowed = allowed & mask[:, None, None, :]
-        return dense_attend(q, cached_key.value, cached_value.value, allowed, self.stable)
+        scores = jnp.einsum(
+            "bnhd,blhd->bhnl", q, cached_key.value,
+            preferred_element_type=jnp.float32,
+        )
+        scores = jnp.where(allowed, scores, NEG_INF)
+        attn = _softmax(scores, self.stable)
+        return jnp.einsum(
+            "bhnl,blhd->bnhd", attn.astype(cached_value.value.dtype), cached_value.value
+        )
