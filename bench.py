@@ -200,9 +200,10 @@ def bench_train(on_cpu: bool):
     return result
 
 
-def bench_generation(on_cpu: bool):
+def bench_generation(on_cpu: bool, int8: bool = False):
     """p50 single-chip autoregressive generation latency: scan-decode the
-    full 1024 image tokens (BASELINE.md metric row 3)."""
+    full 1024 image tokens (BASELINE.md metric row 3). ``int8`` serves the
+    same model through the weight-only-quantized path (utils/quantize.py)."""
     from dalle_pytorch_tpu.models import DALLE
     from dalle_pytorch_tpu.models.sampling import generate_image_tokens
 
@@ -219,11 +220,11 @@ def bench_generation(on_cpu: bool):
     params = jax.jit(dalle.init)(
         jax.random.key(0), text, jnp.zeros((1, fmap * fmap), jnp.int32)
     )["params"]
-    # serve in bf16: decode is HBM-bound on weight reads, so f32 master
-    # params would double the bytes per token (generate.py does the same)
-    params = jax.tree_util.tree_map(
-        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x, params
-    )
+    # bf16 (+ optional int8) serving: decode is HBM-bound on weight reads
+    # (generate.py runs the same transform)
+    from dalle_pytorch_tpu.utils.quantize import prepare_for_serving
+
+    dalle, params = prepare_for_serving(dalle, params, int8=int8)
 
     def gen(key):
         return generate_image_tokens(dalle, params, text, key)
@@ -238,8 +239,9 @@ def bench_generation(on_cpu: bool):
         np.asarray(toks)
         times.append(time.perf_counter() - t0)
     p50 = float(np.percentile(times, 50))
+    name = "gen_latency_p50_image1024_tokens_1chip"
     return {
-        "metric": "gen_latency_p50_image1024_tokens_1chip",
+        "metric": name + ("_int8" if int8 else ""),
         "value": round(p50 * 1e3, 1),
         "unit": "ms",
         "vs_baseline": None,  # reference publishes no latency number
@@ -252,8 +254,10 @@ def bench_generation(on_cpu: bool):
 def main():
     on_cpu = jax.devices()[0].platform == "cpu"
     gen = bench_generation(on_cpu)
+    gen_int8 = bench_generation(on_cpu, int8=True)
     train = bench_train(on_cpu)
     print(json.dumps(gen))
+    print(json.dumps(gen_int8))
     print(json.dumps(train))
 
 

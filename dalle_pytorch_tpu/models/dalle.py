@@ -85,6 +85,7 @@ class DALLE(nn.Module):
     ff_experts: int = 0
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
+    serve_quant: bool = False
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -166,6 +167,7 @@ class DALLE(nn.Module):
             ff_experts=self.ff_experts,
             moe_every=self.moe_every,
             moe_capacity_factor=self.moe_capacity_factor,
+            quant=self.serve_quant,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )
@@ -173,8 +175,11 @@ class DALLE(nn.Module):
         # the vocab projection runs in compute dtype — in f32 this one matmul
         # (n x dim x ~18k vocab) would cost more MXU time than a whole layer;
         # the loss upcasts the logits to f32 before log_softmax
-        self.to_logits = nn.Dense(
-            self.total_tokens, dtype=self.dtype, param_dtype=self.param_dtype
+        from ..ops.layers import serving_dense
+
+        self.to_logits = serving_dense(
+            self.serve_quant, self.total_tokens,
+            dtype=self.dtype, param_dtype=self.param_dtype,
         )
 
     # ------------------------------------------------------------- helpers
@@ -256,6 +261,12 @@ class DALLE(nn.Module):
             lmask = jnp.asarray(self.logits_mask_np()[:n])[None]
             return jnp.where(lmask, NEG_INF, logits.astype(jnp.float32))
 
+        if self.serve_quant:
+            raise ValueError(
+                "serve_quant is an inference-only mode (int8 kernels receive "
+                "no meaningful gradients); train with serve_quant=False and "
+                "quantize the checkpoint via utils/quantize.py"
+            )
         assert image is not None, "when training, image tokens must be supplied"
         assert image.shape[1] == self.image_seq_len, (
             f"the loss needs the full image sequence, got {image.shape[1]} of "

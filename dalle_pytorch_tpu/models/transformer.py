@@ -80,6 +80,7 @@ class Transformer(nn.Module):
     ff_experts: int = 0
     moe_every: int = 2
     moe_capacity_factor: float = 1.25
+    quant: bool = False
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -159,6 +160,7 @@ class Transformer(nn.Module):
                     layout_seed=self.sparse_layout_seed + ind,
                     use_flash=self.use_flash,
                     sp_axis=self.sp_axis,
+                    quant=self.quant,
                     dtype=self.dtype,
                     param_dtype=self.param_dtype,
                 )
@@ -179,6 +181,7 @@ class Transformer(nn.Module):
                     dim=self.dim,
                     mult=self.ff_mult,
                     dropout=self.ff_dropout,
+                    quant=self.quant,
                     dtype=self.dtype,
                     param_dtype=self.param_dtype,
                 )

@@ -107,6 +107,7 @@ class PatternAttention(nn.Module):
     layout_seed: int = 0
     use_flash: bool = True
     sp_axis: Optional[str] = None
+    quant: bool = False
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -156,9 +157,13 @@ class PatternAttention(nn.Module):
         h, d = self.heads, self.dim_head
         inner = h * d
 
-        qkv = nn.Dense(
-            inner * 3, use_bias=False, dtype=self.dtype, param_dtype=self.param_dtype, name="to_qkv"
-        )(x)
+        from .layers import serving_dense
+
+        dense = lambda features, use_bias, name: serving_dense(
+            self.quant, features, use_bias=use_bias, name=name,
+            dtype=self.dtype, param_dtype=self.param_dtype,
+        )
+        qkv = dense(inner * 3, False, "to_qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         if decode:
@@ -196,7 +201,7 @@ class PatternAttention(nn.Module):
                 )
 
             out = out.transpose(0, 2, 1, 3).reshape(b, -1, inner)
-        out = nn.Dense(self.dim, dtype=self.dtype, param_dtype=self.param_dtype, name="to_out")(out)
+        out = dense(self.dim, True, "to_out")(out)
         return nn.Dropout(self.dropout)(out, deterministic=deterministic)
 
     # ------------------------------------------------------------ flash path

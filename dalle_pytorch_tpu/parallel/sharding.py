@@ -26,25 +26,34 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # (path regex, spec) — first match wins. Paths look like
-# "transformer/attn_0/fn/fn/to_qkv/kernel".
+# "transformer/attn_0/fn/fn/to_qkv/kernel" for named projections and
+# "transformer/ff_0/fn/fn/fn/Dense_0/kernel" for the flax-auto-named
+# feed-forward projections (LayerScale(PreNorm(PreShiftToken(FeedForward)))
+# wraps them in anonymous `fn` attributes, so the FeedForward class name
+# never appears in the path). int8 serving renames Dense_i -> QuantDense_i
+# and kernel -> kernel_q (ops/layers.py:QuantDense); the patterns cover
+# both so tensor-parallel serving keeps the Megatron layout. The 1-D
+# bias/scale leaves fall through to the fallback and replicate, which GSPMD
+# reshards for free.
 DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
     # attention: qkv splits heads (output dim) over tp, out-proj splits input
-    (r"to_qkv/kernel$", P("fsdp", "tp")),
-    (r"to_out/kernel$", P("tp", "fsdp")),
-    # GEGLU FF: up-projection splits hidden, down-projection splits input
-    (r"FeedForward_\d+/Dense_0/kernel$", P("fsdp", "tp")),
-    (r"FeedForward_\d+/Dense_1/kernel$", P("tp", "fsdp")),
+    (r"to_qkv/kernel(_q)?$", P("fsdp", "tp")),
+    (r"to_out/kernel(_q)?$", P("tp", "fsdp")),
     # MoE experts: expert dim over ep, hidden over tp (ops/moe.py)
     (r"experts_in$", P("ep", "fsdp", "tp")),
     (r"experts_out$", P("ep", "tp", "fsdp")),
     (r"gate/kernel$", P(None, None)),
-    # gMLP
-    (r"GMLPBlock_\d+/Dense_0/kernel$", P("fsdp", "tp")),
-    (r"GMLPBlock_\d+/Dense_1/kernel$", P("tp", "fsdp")),
     (r"spatial_weight$", P(None, None)),
+    # GEGLU FF / gMLP channel projections: up-projection splits hidden over
+    # tp, down-projection splits input — matched by position inside any
+    # ff_i / attn_i (gMLP) / FeedForward_i (CLIP) block
+    (r"(ff|attn|FeedForward|GMLPBlock)_\d+(/\w+)*/(Quant)?Dense_0/kernel(_q)?$",
+     P("fsdp", "tp")),
+    (r"(ff|attn|FeedForward|GMLPBlock)_\d+(/\w+)*/(Quant)?Dense_1/kernel(_q)?$",
+     P("tp", "fsdp")),
     # vocab-sized tensors: shard the vocab dim over fsdp, features over tp
     (r"(text_emb|image_emb)/embedding$", P("fsdp", "tp")),
-    (r"to_logits/kernel$", P("fsdp", "tp")),
+    (r"to_logits/kernel(_q)?$", P("fsdp", "tp")),
     # CLIP latent projections
     (r"to_(text|visual)_latent/kernel$", P("fsdp", "tp")),
     # VAE convs: shard output channels over tp when large

@@ -6,6 +6,12 @@ from .checkpoint import (
 )
 from .download import CACHE_DIR, download
 from .metrics import MetricsLogger, Throughput, mfu
+from .quantize import (
+    prepare_for_serving,
+    quantize_dalle,
+    quantize_kernel,
+    quantize_params,
+)
 from .schedules import (
     ConstantLR,
     ExponentialDecay,
@@ -25,6 +31,10 @@ __all__ = [
     "load_checkpoint",
     "load_sharded_checkpoint",
     "mfu",
+    "prepare_for_serving",
+    "quantize_dalle",
+    "quantize_kernel",
+    "quantize_params",
     "save_checkpoint",
     "save_sharded_checkpoint",
 ]

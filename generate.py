@@ -34,6 +34,11 @@ def parse_args():
                         help="serve in bf16: halves HBM weight traffic, the "
                              "decode bottleneck (analog of the reference's "
                              "fp16 generation)")
+    parser.add_argument("--int8", action="store_true",
+                        help="weight-only int8 serving: quantize the Dense "
+                             "kernels per output channel at load time, "
+                             "halving weight reads again vs bf16 (the "
+                             "reference has no quantized path)")
     # local weight files for checkpoints trained against a frozen pretrained
     # VAE (whose weights are not bundled in the DALLE checkpoint)
     parser.add_argument("--vqgan_model_path", type=str, default=None)
@@ -69,12 +74,10 @@ def main():
     )
     assert vae is not None, "checkpoint carries no VAE — cannot decode images"
 
-    if args.bf16:
-        dalle = dalle.clone(dtype=jnp.bfloat16)
-        params = jax.tree_util.tree_map(
-            lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
-            params,
-        )
+    if args.bf16 or args.int8:
+        from dalle_pytorch_tpu.utils.quantize import prepare_for_serving
+
+        dalle, params = prepare_for_serving(dalle, params, int8=args.int8)
 
     if args.chinese:
         tokenizer = ChineseTokenizer()
