@@ -168,15 +168,30 @@ def save_dalle_checkpoint(
 
 
 def restore_opt_state(path: str, target: Any) -> Optional[Any]:
-    """Restore the optimizer state saved by ``save_dalle_checkpoint`` into
-    ``target``'s structure (None when the checkpoint carries none), so resume
-    keeps Adam moments instead of silently resetting them."""
+    """Restore the optimizer state saved by ``save_dalle_checkpoint`` /
+    ``save_clip_checkpoint`` into ``target``'s structure (None when the
+    checkpoint carries none), so resume keeps Adam moments instead of
+    silently resetting them."""
     from flax import serialization
 
     state, meta = load_checkpoint(path)
     if not meta.get("has_opt_state"):
         return None
     return serialization.from_state_dict(target, state["opt_state"])
+
+
+def _restore_params(module, init_args: Tuple[Any, ...], state_params: Any) -> Any:
+    """Shape-inferred zero tree for ``module.init(*init_args)`` filled from a
+    checkpoint's params state dict — the one restore idiom shared by the
+    DALLE and CLIP loaders."""
+    import jax
+    from flax import serialization
+
+    shapes = jax.eval_shape(
+        lambda: module.init(jax.random.key(0), *init_args)
+    )["params"]
+    zeros = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    return serialization.from_state_dict(zeros, state_params)
 
 
 def dalle_from_checkpoint(path: str, vae_weight_paths: Optional[dict] = None):
@@ -197,9 +212,7 @@ def dalle_from_checkpoint(path: str, vae_weight_paths: Optional[dict] = None):
     dalle = DALLE(**_restore_dtypes(meta["config"]))
     text = jnp.zeros((1, dalle.text_seq_len), jnp.int32)
     image = jnp.zeros((1, dalle.image_seq_len), jnp.int32)
-    params = jax.eval_shape(lambda: dalle.init(jax.random.key(0), text, image))["params"]
-    params = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), params)
-    params = serialization.from_state_dict(params, state["params"])
+    params = _restore_params(dalle, (text, image), state["params"])
 
     vae = vae_params = None
     wp = vae_weight_paths or {}
@@ -227,3 +240,44 @@ def dalle_from_checkpoint(path: str, vae_weight_paths: Optional[dict] = None):
                 dtype=vae.dtype,
             )
     return dalle, params, vae, vae_params, meta
+
+
+# ------------------------------------------------------------------- CLIP
+
+
+def save_clip_checkpoint(
+    path: str,
+    clip,
+    params: Any,
+    extra: Optional[dict] = None,
+    opt_state: Any = None,
+):
+    """Hparams-carrying CLIP checkpoint (same shape as the DALLE format:
+    {config, params[, opt_state]} so generation reranking needs no flags)."""
+    meta = {
+        "model_class": "CLIP",
+        "config": _config_dict(clip),
+        **(extra or {}),
+    }
+    state = {"params": params}
+    if opt_state is not None:
+        state["opt_state"] = opt_state
+        meta["has_opt_state"] = True
+    save_checkpoint(path, state, meta)
+
+
+def clip_from_checkpoint(path: str) -> Tuple[Any, Any, dict]:
+    """(CLIP module, params, meta) from a save_clip_checkpoint file."""
+    from .clip import CLIP
+
+    state, meta = load_checkpoint(path)
+    assert meta.get("model_class") == "CLIP", (
+        f"not a CLIP checkpoint: {meta.get('model_class')}"
+    )
+    clip = CLIP(**_restore_dtypes(meta["config"]))
+    text = jnp.zeros((1, clip.text_seq_len), jnp.int32)
+    image = jnp.zeros(
+        (1, clip.visual_image_size, clip.visual_image_size, clip.channels)
+    )
+    params = _restore_params(clip, (text, image), state["params"])
+    return clip, params, meta

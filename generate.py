@@ -45,6 +45,11 @@ def parse_args():
     parser.add_argument("--vqgan_config_path", type=str, default=None)
     parser.add_argument("--openai_enc_path", type=str, default=None)
     parser.add_argument("--openai_dec_path", type=str, default=None)
+    parser.add_argument("--clip_path", type=str, default=None,
+                        help="CLIP checkpoint (train_clip.py) to score "
+                             "generations; images are saved best-first "
+                             "(reference generate_images clip rerank, "
+                             "dalle_pytorch.py:503-505)")
     return parser.parse_args()
 
 
@@ -86,6 +91,12 @@ def main():
     else:
         tokenizer = SimpleTokenizer(args.bpe_path)
 
+    clip = clip_params = None
+    if args.clip_path:
+        from dalle_pytorch_tpu.models.factory import clip_from_checkpoint
+
+        clip, clip_params, _ = clip_from_checkpoint(args.clip_path)
+
     texts = [t.strip() for t in args.text.split("|") if t.strip()]
     outputs_dir = Path(args.outputs_dir)
 
@@ -121,6 +132,24 @@ def main():
         images = np.concatenate(images)[: args.num_images]
 
         images = denormalize(images, getattr(vae, "normalization", None))
+
+        if clip is not None:
+            # rerank: save best-scoring generations first (reference
+            # dalle_pytorch.py:503-505)
+            clip_imgs = jax.image.resize(
+                jnp.asarray(images),
+                (len(images), clip.visual_image_size, clip.visual_image_size, 3),
+                method="bilinear",
+            )
+            clip_text = jnp.asarray(
+                tokenizer.tokenize([text], clip.text_seq_len, truncate_text=True)
+            ).repeat(len(images), axis=0)
+            scores = clip.apply(
+                {"params": clip_params}, clip_text, clip_imgs,
+                text_mask=clip_text != 0,
+            )
+            order = np.argsort(-np.asarray(scores))
+            images = images[order]
 
         sub_dir = outputs_dir / text.replace(" ", "_")[:100]
         sub_dir.mkdir(parents=True, exist_ok=True)

@@ -244,3 +244,81 @@ def test_generate_cli_produces_images(trained_dalle, tmp_path):
         arr = np.asarray(Image.open(pngs[0]))
         assert arr.shape == (IMAGE_SIZE, IMAGE_SIZE, 3)
         assert arr.dtype == np.uint8
+
+
+def test_train_clip_cli_and_rerank(shapes_dataset, trained_dalle, tmp_path):
+    """train_clip.py trains end-to-end on the shapes dataset and its
+    checkpoint plugs into generate.py --clip_path for sampling-time
+    reranking (the reference has CLIP but no trainer for it)."""
+    import generate
+    import train_clip
+    from dalle_pytorch_tpu.utils import MetricsLogger
+
+    out = tmp_path / "clip"
+    losses = []
+    orig_log = MetricsLogger.log
+
+    def capture(self, logs, step=None):
+        if "loss" in logs:
+            losses.append(float(logs["loss"]))
+        return orig_log(self, logs, step=step)
+
+    argv = [
+        "--image_text_folder", str(shapes_dataset),
+        "--dim_text", "32",
+        "--dim_image", "32",
+        "--dim_latent", "32",
+        "--text_enc_depth", "1",
+        "--text_seq_len", "16",
+        "--text_heads", "2",
+        "--visual_enc_depth", "1",
+        "--visual_heads", "2",
+        "--visual_image_size", str(IMAGE_SIZE),
+        "--visual_patch_size", "8",
+        "--truncate_captions",
+        "--batch_size", "8",
+        "--epochs", "4",
+        "--learning_rate", "2e-3",
+        "--clip_output_file_name", str(out),
+    ]
+    mp = pytest.MonkeyPatch()
+    try:
+        mp.setattr(MetricsLogger, "log", capture)
+        _run_cli(mp, train_clip, argv)
+    finally:
+        mp.undo()
+    ckpt = Path(f"{out}.ckpt")
+    assert ckpt.exists()
+    assert losses and all(np.isfinite(losses))
+    assert losses[-1] < losses[0], f"CLIP loss did not decrease: {losses}"
+
+    # resume: params AND Adam moments restore (epoch counter advances)
+    n_before = len(losses)
+    argv_resume = ["--clip_path", str(ckpt)] + [
+        a for a in argv if a not in ("--clip_output_file_name", str(out))
+    ] + ["--clip_output_file_name", str(out), "--epochs", "6"]
+    mp = pytest.MonkeyPatch()
+    try:
+        mp.setattr(MetricsLogger, "log", capture)
+        _run_cli(mp, train_clip, argv_resume)
+    finally:
+        mp.undo()
+    assert len(losses) > n_before, "resume ran no steps"
+    assert all(np.isfinite(losses))
+
+    outputs = tmp_path / "reranked"
+    argv = [
+        "--dalle_path", str(trained_dalle),
+        "--text", "a red square",
+        "--num_images", "2",
+        "--batch_size", "2",
+        "--clip_path", str(ckpt),
+        "--outputs_dir", str(outputs),
+    ]
+    mp = pytest.MonkeyPatch()
+    try:
+        _run_cli(mp, generate, argv)
+    finally:
+        mp.undo()
+    pngs = sorted((outputs / "a_red_square").glob("*.png"))
+    assert len(pngs) == 2
