@@ -246,6 +246,31 @@ def test_generate_cli_produces_images(trained_dalle, tmp_path):
         assert arr.dtype == np.uint8
 
 
+def test_generate_cli_int8(trained_dalle, tmp_path):
+    """--int8 quantized serving through the real CLI (load-time bf16 cast +
+    per-channel kernel quantization, utils/quantize.py)."""
+    import generate
+
+    outputs = tmp_path / "outputs_int8"
+    argv = [
+        "--dalle_path", str(trained_dalle),
+        "--text", "a green circle",
+        "--num_images", "1",
+        "--batch_size", "1",
+        "--int8",
+        "--outputs_dir", str(outputs),
+    ]
+    mp = pytest.MonkeyPatch()
+    try:
+        _run_cli(mp, generate, argv)
+    finally:
+        mp.undo()
+    pngs = sorted((outputs / "a_green_circle").glob("*.png"))
+    assert len(pngs) == 1
+    arr = np.asarray(Image.open(pngs[0]))
+    assert arr.shape == (IMAGE_SIZE, IMAGE_SIZE, 3)
+
+
 def test_train_clip_cli_and_rerank(shapes_dataset, trained_dalle, tmp_path):
     """train_clip.py trains end-to-end on the shapes dataset and its
     checkpoint plugs into generate.py --clip_path for sampling-time
