@@ -164,3 +164,27 @@ def test_bfloat16_forward_close():
     np.testing.assert_allclose(
         out.astype(jnp.float32), ref, atol=5e-2, rtol=5e-2
     )
+
+
+@pytest.mark.slow
+def test_flagship_production_block_parity():
+    """seq 1280 at the PRODUCTION block size (_flash_block(1280) — one
+    whole-row block), not a test-sized one: block-size-dependent code
+    (diagonal classification, scratch shapes, the kb==0 / kb==nk-1
+    epilogues) must be exercised at the configuration the flagship model
+    actually dispatches to."""
+    from dalle_pytorch_tpu.ops.attention import _flash_block
+
+    n = 1280
+    block = _flash_block(n)
+    assert block == 1280, "update this test if the block heuristic changes"
+    q, k, v = _qkv(jax.random.PRNGKey(5), 1, 2, n, 64)
+    out = _flash(q, k, v, True, None, block)
+    want = _oracle(q, k, v, masks_lib.causal_mask(n))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+    def loss(q):
+        return _flash(q, k, v, True, None, block).sum()
+
+    g = jax.grad(loss)(q)
+    assert bool(jnp.isfinite(g).all())
