@@ -251,11 +251,30 @@ def bench_generation(on_cpu: bool, int8: bool = False):
     }
 
 
+def _retry(fn, attempts: int = 3):
+    """The remote-compile transport occasionally drops a response mid-read
+    (transient INTERNAL error); a retry hits the compile cache and is cheap.
+    Anything else re-raises immediately."""
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # jax.errors.JaxRuntimeError has no stable type here
+            s = str(e)
+            # match only the remote-transport failure signature; deterministic
+            # XLA INTERNAL compiler errors must surface immediately
+            transient = "remote_compile" in s or "response body closed" in s
+            if not transient or i == attempts - 1:
+                raise
+            print(f"transient backend error, retrying ({i + 1}/{attempts}): "
+                  f"{str(e)[:120]}", file=sys.stderr)
+            time.sleep(5)
+
+
 def main():
     on_cpu = jax.devices()[0].platform == "cpu"
-    gen = bench_generation(on_cpu)
-    gen_int8 = bench_generation(on_cpu, int8=True)
-    train = bench_train(on_cpu)
+    gen = _retry(lambda: bench_generation(on_cpu))
+    gen_int8 = _retry(lambda: bench_generation(on_cpu, int8=True))
+    train = _retry(lambda: bench_train(on_cpu))
     print(json.dumps(gen))
     print(json.dumps(gen_int8))
     print(json.dumps(train))

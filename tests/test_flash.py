@@ -180,11 +180,25 @@ def test_flagship_production_block_parity():
     assert block == 1280, "update this test if the block heuristic changes"
     q, k, v = _qkv(jax.random.PRNGKey(5), 1, 2, n, 64)
     out = _flash(q, k, v, True, None, block)
-    want = _oracle(q, k, v, masks_lib.causal_mask(n))
+    mask = masks_lib.causal_mask(n)
+    want = _oracle(q, k, v, mask)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5, rtol=2e-5)
 
-    def loss(q):
-        return _flash(q, k, v, True, None, block).sum()
+    # gradient PARITY at the single-block configuration (nk == 1, so the
+    # kb==0 and kb==nk-1 epilogues coincide) — finiteness alone would miss
+    # a wrong accumulation there
+    cot = jax.random.normal(jax.random.PRNGKey(7), out.shape)
 
-    g = jax.grad(loss)(q)
-    assert bool(jnp.isfinite(g).all())
+    def flash_loss(q, k, v):
+        return (_flash(q, k, v, True, None, block) * cot).sum()
+
+    def oracle_loss(q, k, v):
+        return (_oracle(q, k, v, mask) * cot).sum()
+
+    got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    want_g = jax.grad(oracle_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want_g, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), atol=5e-5, rtol=5e-5,
+            err_msg=f"d{name} mismatch at production block",
+        )
