@@ -64,6 +64,23 @@ def _run_cli(monkeypatch, module, argv):
     module.main()
 
 
+def _capture_losses(monkeypatch):
+    """Patch MetricsLogger.log to record every logged 'loss'; returns the
+    list the values accumulate into."""
+    from dalle_pytorch_tpu.utils import MetricsLogger
+
+    losses = []
+    orig_log = MetricsLogger.log
+
+    def capture(self, logs, step=None):
+        if "loss" in logs:
+            losses.append(float(logs["loss"]))
+        return orig_log(self, logs, step=step)
+
+    monkeypatch.setattr(MetricsLogger, "log", capture)
+    return losses
+
+
 @pytest.fixture(scope="module")
 def trained_vae(shapes_dataset, tmp_path_factory):
     import train_vae
@@ -124,18 +141,9 @@ def test_vae_training_reduces_recon_loss(trained_vae, shapes_dataset):
 @pytest.fixture(scope="module")
 def trained_dalle(shapes_dataset, trained_vae, tmp_path_factory):
     import train_dalle
-    from dalle_pytorch_tpu.utils import MetricsLogger
 
     work = tmp_path_factory.mktemp("dalle_work")
     out = work / "dalle"
-    losses = []
-    orig_log = MetricsLogger.log
-
-    def capture(self, logs, step=None):
-        if "loss" in logs:
-            losses.append(float(logs["loss"]))
-        return orig_log(self, logs, step=step)
-
     argv = [
         "--image_text_folder", str(shapes_dataset),
         "--vae_path", str(trained_vae),
@@ -155,7 +163,7 @@ def trained_dalle(shapes_dataset, trained_vae, tmp_path_factory):
     ]
     mp = pytest.MonkeyPatch()
     try:
-        mp.setattr(MetricsLogger, "log", capture)
+        losses = _capture_losses(mp)
         mp.chdir(work)
         _run_cli(mp, train_dalle, argv)
     finally:
@@ -185,17 +193,8 @@ def test_train_cli_parallel_modes(shapes_dataset, trained_vae, tmp_path,
     Ulysses) and pipeline parallelism (GPipe) over the virtual 8-device mesh
     — the CLI analog of the model-level parity tests."""
     import train_dalle
-    from dalle_pytorch_tpu.utils import MetricsLogger
 
     out = tmp_path / "dalle_par"
-    losses = []
-    orig_log = MetricsLogger.log
-
-    def capture(self, logs, step=None):
-        if "loss" in logs:
-            losses.append(float(logs["loss"]))
-        return orig_log(self, logs, step=step)
-
     argv = [
         "--image_text_folder", str(shapes_dataset),
         "--vae_path", str(trained_vae),
@@ -212,7 +211,7 @@ def test_train_cli_parallel_modes(shapes_dataset, trained_vae, tmp_path,
         "--dalle_output_file_name", str(out),
         *mesh_flags,
     ]
-    monkeypatch.setattr(MetricsLogger, "log", capture)
+    losses = _capture_losses(monkeypatch)
     monkeypatch.chdir(tmp_path)
     _run_cli(monkeypatch, train_dalle, argv)
     assert Path(f"{out}.ckpt").exists()
@@ -277,17 +276,8 @@ def test_train_clip_cli_and_rerank(shapes_dataset, trained_dalle, tmp_path):
     reranking (the reference has CLIP but no trainer for it)."""
     import generate
     import train_clip
-    from dalle_pytorch_tpu.utils import MetricsLogger
 
     out = tmp_path / "clip"
-    losses = []
-    orig_log = MetricsLogger.log
-
-    def capture(self, logs, step=None):
-        if "loss" in logs:
-            losses.append(float(logs["loss"]))
-        return orig_log(self, logs, step=step)
-
     argv = [
         "--image_text_folder", str(shapes_dataset),
         "--dim_text", "32",
@@ -308,7 +298,7 @@ def test_train_clip_cli_and_rerank(shapes_dataset, trained_dalle, tmp_path):
     ]
     mp = pytest.MonkeyPatch()
     try:
-        mp.setattr(MetricsLogger, "log", capture)
+        losses = _capture_losses(mp)
         _run_cli(mp, train_clip, argv)
     finally:
         mp.undo()
@@ -318,18 +308,17 @@ def test_train_clip_cli_and_rerank(shapes_dataset, trained_dalle, tmp_path):
     assert losses[-1] < losses[0], f"CLIP loss did not decrease: {losses}"
 
     # resume: params AND Adam moments restore (epoch counter advances)
-    n_before = len(losses)
     argv_resume = ["--clip_path", str(ckpt)] + [
         a for a in argv if a not in ("--clip_output_file_name", str(out))
     ] + ["--clip_output_file_name", str(out), "--epochs", "6"]
     mp = pytest.MonkeyPatch()
     try:
-        mp.setattr(MetricsLogger, "log", capture)
+        resume_losses = _capture_losses(mp)
         _run_cli(mp, train_clip, argv_resume)
     finally:
         mp.undo()
-    assert len(losses) > n_before, "resume ran no steps"
-    assert all(np.isfinite(losses))
+    assert resume_losses, "resume ran no steps"
+    assert all(np.isfinite(resume_losses))
 
     outputs = tmp_path / "reranked"
     argv = [
@@ -347,3 +336,66 @@ def test_train_clip_cli_and_rerank(shapes_dataset, trained_dalle, tmp_path):
         mp.undo()
     pngs = sorted((outputs / "a_red_square").glob("*.png"))
     assert len(pngs) == 2
+
+
+def test_train_dalle_cli_webdataset(shapes_dataset, trained_vae, tmp_path, monkeypatch):
+    """train_dalle --wds: the tar-shard streaming pipeline through the real
+    CLI (reference train_dalle.py:353-374 WebDataset path)."""
+    import tarfile
+
+    import train_dalle
+
+    shard = tmp_path / "shard-0000.tar"
+    with tarfile.open(shard, "w") as tf:
+        for p in sorted(shapes_dataset.glob("*.png")):
+            tf.add(p, arcname=p.name)
+            tf.add(p.with_suffix(".txt"), arcname=p.with_suffix(".txt").name)
+
+    out = tmp_path / "dalle_wds"
+    argv = [
+        "--image_text_folder", str(shard),
+        "--wds",
+        "--vae_path", str(trained_vae),
+        "--dim", "64",
+        "--depth", "2",
+        "--heads", "2",
+        "--dim_head", "16",
+        "--text_seq_len", "16",
+        "--batch_size", "8",
+        "--epochs", "2",
+        "--learning_rate", "1e-3",
+        "--truncate_captions",
+        "--dalle_output_file_name", str(out),
+    ]
+    losses = _capture_losses(monkeypatch)
+    monkeypatch.chdir(tmp_path)
+    _run_cli(monkeypatch, train_dalle, argv)
+    assert Path(f"{out}.ckpt").exists()
+    assert losses and all(np.isfinite(losses))
+
+
+def test_generate_cli_gentxt(trained_dalle, tmp_path):
+    """--gentxt: the model completes the prompt text before generating
+    (reference generate.py:104-106)."""
+    import generate
+
+    outputs = tmp_path / "outputs_gentxt"
+    argv = [
+        "--dalle_path", str(trained_dalle),
+        "--text", "a red",
+        "--num_images", "1",
+        "--batch_size", "1",
+        "--gentxt",
+        "--outputs_dir", str(outputs),
+    ]
+    mp = pytest.MonkeyPatch()
+    try:
+        _run_cli(mp, generate, argv)
+    finally:
+        mp.undo()
+    # the completion is model-sampled text; locate outputs by content, not by
+    # a predicted directory name (sampled tokens may even contain '/')
+    captions = list(outputs.rglob("caption.txt"))
+    assert len(captions) == 1
+    assert captions[0].read_text().startswith("a red")
+    assert len(sorted(captions[0].parent.glob("*.png"))) == 1
