@@ -147,3 +147,32 @@ def test_sharding_rules_cover_real_and_quant_paths(trained):
     for path, (shape, want) in cases.items():
         got = partition_spec(path, shape, mesh)
         assert got == want, f"{path}: {got} != {want}"
+
+
+def test_real_tree_ff_kernels_get_megatron_specs(trained):
+    """Walk the ACTUAL parameter tree (not hand-written path strings): every
+    feed-forward and attention projection kernel must carry the Megatron
+    tp layout under an fsdp x tp mesh — this is what guards against flax
+    auto-naming drift silently downgrading kernels to the fsdp fallback."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from dalle_pytorch_tpu.parallel.sharding import params_shardings
+
+    dalle, params, _, _ = trained
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("fsdp", "tp"))
+    shardings = params_shardings(params, mesh)
+    flat = {
+        jax.tree_util.keystr(p): s.spec
+        for p, s in jax.tree_util.tree_leaves_with_path(shardings)
+    }
+
+    up = [k for k in flat if k.endswith("['Dense_0']['kernel']")]
+    down = [k for k in flat if k.endswith("['Dense_1']['kernel']")]
+    qkv = [k for k in flat if k.endswith("['to_qkv']['kernel']")]
+    out = [k for k in flat if k.endswith("['to_out']['kernel']")]
+    assert up and down and qkv and out, sorted(flat)[:10]
+    for k in up + qkv:
+        assert flat[k] == P("fsdp", "tp"), (k, flat[k])
+    for k in down + out:
+        assert flat[k] == P("tp", "fsdp"), (k, flat[k])
+    assert flat["['to_logits']['kernel']"] == P("fsdp", "tp")
