@@ -11,8 +11,10 @@ full (block_q x d x block_k) matmuls:
   sequentially with running (max, denom, unnormalized out) in VMEM scratch;
   emits per-row logsumexp for the backward;
 - backward: recompute-based (FlashAttention-2 decomposition, no stored
-  probabilities): one kernel accumulates dq over k blocks, another (dk, dv)
-  over q blocks;
+  probabilities): one kernel accumulates dq over k blocks — and computes
+  delta = rowsum(do*o) in-kernel from blocks already in VMEM (no separate
+  elementwise pass over do/o in HBM) — another accumulates (dk, dv) over
+  q blocks, consuming the emitted delta;
 - masking: ``causal=True`` is analytic (above-diagonal blocks execute no
   dots); an optional static (n, n) pattern mask (ops/masks.py) is streamed
   blockwise for sparse/axial/conv layouts with all-empty blocks skipped the
@@ -163,8 +165,8 @@ def _fwd_kernel(
 
 
 def _bwd_dq_kernel(
-    scalar_ref, q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
-    dq_ref, dq_scr,
+    scalar_ref, q_ref, k_ref, v_ref, mask_ref, do_ref, o_ref, lse_ref,
+    dq_ref, delta_ref, dq_scr, delta_scr,
     *, sm_scale, block_q, block_k, nk,
 ):
     qb, kb = pl.program_id(1), pl.program_id(2)
@@ -172,6 +174,13 @@ def _bwd_dq_kernel(
     @pl.when(kb == 0)
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
+        # delta = rowsum(do * o), computed here from the blocks already in
+        # VMEM instead of a separate elementwise pass over do/o in HBM; the
+        # dkv kernel consumes the emitted delta_ref
+        delta_scr[:, 0:1] = jnp.sum(
+            do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
 
     visit = scalar_ref[0, qb * nk + kb]
 
@@ -186,7 +195,7 @@ def _bwd_dq_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - _row_vec(delta_ref)) * sm_scale
+        ds = p * (dp - delta_scr[:, 0:1]) * sm_scale
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -195,6 +204,7 @@ def _bwd_dq_kernel(
     @pl.when(kb == nk - 1)
     def _():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+        delta_ref[0] = jax.lax.transpose(delta_scr[:, 0:1], (1, 0))
 
 
 def _bwd_dkv_kernel(
@@ -405,13 +415,11 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
     scale = d**-0.5 if sm_scale is None else sm_scale
     bh = b * h
 
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    qf, kf, vf, dof = (t.reshape(bh, n, d) for t in (q, k, v, do))
+    qf, kf, vf, dof, of = (t.reshape(bh, n, d) for t in (q, k, v, do, o))
     lsef = lse.reshape(bh, 1, n)
-    deltaf = delta.reshape(bh, 1, n)
     mask_op = [] if mask_np is None else [jnp.asarray(mask_np, jnp.int8)]
 
-    # ---- dq over k blocks --------------------------------------------------
+    # ---- dq over k blocks (also emits delta = rowsum(do*o) for dkv) -------
     def kv_im(bhi, qb, kb, s):
         return (bhi, kb, 0)
 
@@ -424,7 +432,7 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
             if mask_np is not None else []
         ),
         pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0)),
-        pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb, s: (bhi, 0, qb)),
+        pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0)),
         pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb, s: (bhi, 0, qb)),
     ]
     dq_kernel = _with_optional_mask(
@@ -432,23 +440,30 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
             _bwd_dq_kernel, sm_scale=scale, block_q=block_q, block_k=block_k, nk=nk
         ),
         mask_np is not None,
-        n_out=1,
-        n_scratch=1,
+        n_out=2,
+        n_scratch=2,
     )
-    (dq,) = _call(
+    dq, deltaf = _call(
         dq_kernel,
         grid=(bh, nq, nk),
         in_specs=dq_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0))
+            pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb, s: (bhi, 0, qb)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((bh, n, d), q.dtype)],
-        scratch=[pltpu.VMEM((block_q, d), jnp.float32)],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, n), jnp.float32),
+        ],
+        scratch=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
         scalar=jnp.asarray(_scalar_table(visit)),
-        operands=[qf, kf, vf, *mask_op, dof, lsef, deltaf],
+        operands=[qf, kf, vf, *mask_op, dof, of, lsef],
         interpret=interpret,
         cost=_kernel_cost(visit, bh, block_q, block_k, d, 3,
-                          2 * block_k, 3 * block_q, q.dtype.itemsize),
+                          2 * block_k, 4 * block_q, q.dtype.itemsize),
     )
 
     # ---- dk/dv over q blocks ----------------------------------------------
