@@ -3,6 +3,7 @@ vendored BPE vocab and the native engine sources (the reference ships its
 vocab via MANIFEST.in; this framework must stand alone, VERDICT round-1
 item 5). Runs the same check the publish workflow performs."""
 
+import shutil
 import subprocess
 import sys
 import zipfile
@@ -15,11 +16,24 @@ REPO = Path(__file__).resolve().parent.parent
 
 @pytest.mark.slow
 def test_wheel_ships_vocab_and_native_sources(tmp_path):
+    # build from a clean copy of the tracked tree: an in-repo build would
+    # leave (and later silently reuse) a stale build/lib that can mask a
+    # broken package-data config
+    src = tmp_path / "src"
+    src.mkdir()
+    archive = subprocess.run(
+        ["git", "archive", "HEAD"], cwd=REPO, capture_output=True,
+    )
+    assert archive.returncode == 0, archive.stderr[-300:]
+    subprocess.run(
+        ["tar", "-x", "-C", str(src)], input=archive.stdout, check=True,
+    )
     build = subprocess.run(
         [sys.executable, "-m", "pip", "wheel", "--no-deps", "--no-build-isolation",
-         "-w", str(tmp_path), str(REPO)],
+         "-w", str(tmp_path), str(src)],
         capture_output=True, text=True,
     )
+    shutil.rmtree(src, ignore_errors=True)
     assert build.returncode == 0, f"wheel build failed: {build.stderr[-500:]}"
     wheels = list(tmp_path.glob("*.whl"))
     assert wheels, "no wheel produced"
