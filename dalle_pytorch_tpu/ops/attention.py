@@ -190,11 +190,10 @@ class PatternAttention(nn.Module):
             elif (
                 self.use_flash
                 and not force_dense
-                and mask is None
                 and self.attn_type in ("full", "sparse")
                 and _flash_block(n) > 0
             ):
-                out = self._flash_attend(q, k, v, n)
+                out = self._flash_attend(q, k, v, n, mask)
             else:
                 out = self._pattern_attend(
                     q * (d**-0.5), k, v, mask, force_dense=force_dense
@@ -206,16 +205,21 @@ class PatternAttention(nn.Module):
 
     # ------------------------------------------------------------ flash path
 
-    def _flash_attend(self, q, k, v, n: int):
+    def _flash_attend(self, q, k, v, n: int, mask=None):
         """Fused Pallas kernel for the dense-causal and block-sparse patterns
         (ops/flash_attention.py): O(n·d) memory, per-block skip of masked-out
-        regions. Falls back to interpret mode off-TPU so tests run anywhere."""
+        regions. A runtime (b, n) key-padding mask streams through the kernel
+        as a fourth operand — no dense (n, n) fallback. The non-causal full
+        pattern is analytic (all blocks dense), so it carries no (n, n)
+        pattern operand either. Falls back to interpret mode off-TPU so
+        tests run anywhere."""
         block = _flash_block(n)
         pattern = None
-        if self.attn_type == "sparse" or not self.causal:
+        if self.attn_type == "sparse":
             pattern = _cached_flash_mask(self, n)
         return flash_attention(
             q, k, v,
+            key_mask=None if mask is None else mask[:, :n],
             causal=self.causal,
             pattern_mask=pattern,
             sm_scale=self.dim_head**-0.5,

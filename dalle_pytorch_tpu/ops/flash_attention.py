@@ -18,8 +18,15 @@ full (block_q x d x block_k) matmuls:
 - masking: ``causal=True`` is analytic (above-diagonal blocks execute no
   dots); an optional static (n, n) pattern mask (ops/masks.py) is streamed
   blockwise for sparse/axial/conv layouts with all-empty blocks skipped the
-  same way. This one kernel therefore covers both the reference's dense
-  causal attention and its DeepSpeed variable-sparsity kernel semantics.
+  same way; an optional runtime (b, n) key-padding mask (the reference's
+  ``mask`` argument, attention.py:71-74) is a fourth streamed operand —
+  (1, block_k) per grid step — folded into the scores after the static
+  mask, so masked training/CLIP text padding keeps the O(n·d) memory
+  guarantee instead of falling back to dense (n, n) scores. Rows whose
+  every key is masked produce exactly 0 output and 0 gradient (the
+  ``_masked_exp`` guard). This one kernel therefore covers the reference's
+  dense causal attention, its pad-mask handling, and its DeepSpeed
+  variable-sparsity kernel semantics.
   Skipped blocks still DMA their K/V block: index_maps must stay affine in
   the grid indices — an earlier revision routed them through the
   scalar-prefetch table to re-fetch the last live block, which defeats
@@ -96,18 +103,24 @@ def _scalar_table(visit: np.ndarray) -> np.ndarray:
 # ------------------------------------------------------------------ kernels
 
 
-def _masked_scores(q, k, sm_scale, mask_ref, visit, row0, col0, bq, bk):
-    """(bq, bk) f32 scores with pattern/causal masking applied. The QK^T dot
-    runs in the inputs' dtype (bf16 on the MXU fast path) with f32
-    accumulation; the scale is applied on the f32 result."""
+def _masked_scores(q, k, sm_scale, mask_ref, kmask_ref, visit, row0, col0, bq, bk):
+    """(bq, bk) f32 scores with pattern/causal and runtime key masking
+    applied. The QK^T dot runs in the inputs' dtype (bf16 on the MXU fast
+    path) with f32 accumulation; the scale is applied on the f32 result.
+    ``kmask_ref``: optional (1, 1, bk) int32 block of the runtime
+    key-padding mask, broadcast over query rows."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * sm_scale
     if mask_ref is not None:
-        return jnp.where(mask_ref[:] > 0, s, NEG_INF)
-    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + row0
-    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + col0
-    return jnp.where(jnp.logical_or(visit == 2, rows >= cols), s, NEG_INF)
+        s = jnp.where(mask_ref[:] > 0, s, NEG_INF)
+    else:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + row0
+        cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + col0
+        s = jnp.where(jnp.logical_or(visit == 2, rows >= cols), s, NEG_INF)
+    if kmask_ref is not None:
+        s = jnp.where(kmask_ref[0] > 0, s, NEG_INF)  # (1, bk) over rows
+    return s
 
 
 def _row_vec(ref):
@@ -124,7 +137,7 @@ def _masked_exp(s, x):
 
 
 def _fwd_kernel(
-    scalar_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+    scalar_ref, q_ref, k_ref, v_ref, mask_ref, kmask_ref, o_ref, lse_ref,
     m_scr, l_scr, acc_scr,
     *, sm_scale, block_q, block_k, nk,
 ):
@@ -141,7 +154,7 @@ def _fwd_kernel(
     @pl.when(visit > 0)
     def _():
         s = _masked_scores(
-            q_ref[0], k_ref[0], sm_scale, mask_ref, visit,
+            q_ref[0], k_ref[0], sm_scale, mask_ref, kmask_ref, visit,
             qb * block_q, kb * block_k, block_q, block_k,
         )
         m_prev = m_scr[:, 0:1]
@@ -165,7 +178,7 @@ def _fwd_kernel(
 
 
 def _bwd_dq_kernel(
-    scalar_ref, q_ref, k_ref, v_ref, mask_ref, do_ref, o_ref, lse_ref,
+    scalar_ref, q_ref, k_ref, v_ref, mask_ref, kmask_ref, do_ref, o_ref, lse_ref,
     dq_ref, delta_ref, dq_scr, delta_scr,
     *, sm_scale, block_q, block_k, nk,
 ):
@@ -188,7 +201,7 @@ def _bwd_dq_kernel(
     def _():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         s = _masked_scores(
-            q, k, sm_scale, mask_ref, visit,
+            q, k, sm_scale, mask_ref, kmask_ref, visit,
             qb * block_q, kb * block_k, block_q, block_k,
         )
         p = _masked_exp(s, _row_vec(lse_ref))
@@ -208,7 +221,7 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    scalar_ref, q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref, delta_ref,
+    scalar_ref, q_ref, k_ref, v_ref, mask_ref, kmask_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_scr, dv_scr,
     *, sm_scale, block_q, block_k, nq,
 ):
@@ -225,7 +238,7 @@ def _bwd_dkv_kernel(
     def _():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         s = _masked_scores(
-            q, k, sm_scale, mask_ref, visit,
+            q, k, sm_scale, mask_ref, kmask_ref, visit,
             qb * block_q, kb * block_k, block_q, block_k,
         )
         p = _masked_exp(s, _row_vec(lse_ref))
@@ -315,21 +328,38 @@ def _call(kernel, grid, in_specs, out_specs, out_shape, scratch, scalar, operand
     )(scalar, *operands)
 
 
-def _with_optional_mask(kernel, has_mask, n_out, n_scratch):
-    """Adapt a kernel with a mask_ref slot to calls without a mask operand."""
+def _with_optional_masks(kernel, has_mask, has_kmask, n_out, n_scratch):
+    """Adapt a kernel with (mask_ref, kmask_ref) slots to calls missing
+    either optional operand: the pattern mask and/or the runtime key mask."""
 
     def wrapped(*refs):
-        if has_mask:
-            return kernel(*refs)
         split = len(refs) - n_out - n_scratch
-        ins = refs[:split]
+        ins = list(refs[:split])
         rest = refs[split:]
-        return kernel(*ins[:4], None, *ins[4:], *rest)
+        fixed, tail = ins[:4], ins[4:]  # scalar, q, k, v | optional + extras
+        mask_ref = tail.pop(0) if has_mask else None
+        kmask_ref = tail.pop(0) if has_kmask else None
+        return kernel(*fixed, mask_ref, kmask_ref, *tail, *rest)
 
     return wrapped
 
 
-def _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interpret):
+def _bcast_key_mask(key_mask, b, h, n):
+    """(b, n) bool key mask -> (b*h, 1, n) int32 streamed operand. The
+    middle singleton keeps the block's sublane dimension equal to the
+    array's (Mosaic requires block dims divisible by (8, 128) or equal to
+    the array dims — the same layout trick as the lse operand). int32, not
+    int8 like the pattern-mask operand: Mosaic on v5e cannot compare the
+    packed vector<...xi8> layout this (1, 1, bk) block lowers to ("Target
+    does not support this comparison"); the operand is (b·h, n) ints total,
+    ~1/(2d) of one K operand, so the wider dtype is noise."""
+    assert key_mask.shape == (b, n), (key_mask.shape, (b, n))
+    return jnp.broadcast_to(
+        key_mask[:, None, :].astype(jnp.int32), (b, h, n)
+    ).reshape(b * h, 1, n)
+
+
+def _flash_fwd(q, k, v, key_mask, causal, pattern_mask, sm_scale, block_q, block_k, interpret):
     b, h, n, d, nq, nk, mask_np, visit = _prep(q, pattern_mask, block_q, block_k, causal)
     scale = d**-0.5 if sm_scale is None else sm_scale
     bh = b * h
@@ -352,12 +382,18 @@ def _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interp
             pl.BlockSpec((block_q, block_k), lambda bhi, qb, kb, s: (qb, kb))
         )
         operands.append(jnp.asarray(mask_np, jnp.int8))
+    if key_mask is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, block_k), lambda bhi, qb, kb, s: (bhi, 0, kb))
+        )
+        operands.append(_bcast_key_mask(key_mask, b, h, n))
 
-    kernel = _with_optional_mask(
+    kernel = _with_optional_masks(
         functools.partial(
             _fwd_kernel, sm_scale=scale, block_q=block_q, block_k=block_k, nk=nk
         ),
         mask_np is not None,
+        key_mask is not None,
         n_out=2,
         n_scratch=3,
     )
@@ -387,9 +423,10 @@ def _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interp
     return o.reshape(b, h, n, d), lse.reshape(b, h, n)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def flash_attention(
     q, k, v,
+    key_mask=None,
     causal: bool = True,
     pattern_mask=None,
     sm_scale: Optional[float] = None,
@@ -399,18 +436,21 @@ def flash_attention(
 ):
     """Fused attention over (b, h, n, d); q is NOT pre-scaled (``sm_scale``
     defaults to d**-0.5). ``pattern_mask``: static (n, n) bool array,
-    True = may attend; hash by id, so build it once at model setup."""
-    o, _ = _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interpret)
+    True = may attend; hash by id, so build it once at model setup.
+    ``key_mask``: runtime (b, n) bool array, True = key is attendable
+    (the reference's pad mask, attention.py:71-74); rows with every key
+    masked return exactly 0."""
+    o, _ = _flash_fwd(q, k, v, key_mask, causal, pattern_mask, sm_scale, block_q, block_k, interpret)
     return o
 
 
-def _fwd_rule(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, causal, pattern_mask, sm_scale, block_q, block_k, interpret)
-    return o, (q, k, v, o, lse)
+def _fwd_rule(q, k, v, key_mask, causal, pattern_mask, sm_scale, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, key_mask, causal, pattern_mask, sm_scale, block_q, block_k, interpret)
+    return o, (q, k, v, key_mask, o, lse)
 
 
 def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, do):
-    q, k, v, o, lse = res
+    q, k, v, key_mask, o, lse = res
     b, h, n, d, nq, nk, mask_np, visit = _prep(q, pattern_mask, block_q, block_k, causal)
     scale = d**-0.5 if sm_scale is None else sm_scale
     bh = b * h
@@ -418,6 +458,7 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
     qf, kf, vf, dof, of = (t.reshape(bh, n, d) for t in (q, k, v, do, o))
     lsef = lse.reshape(bh, 1, n)
     mask_op = [] if mask_np is None else [jnp.asarray(mask_np, jnp.int8)]
+    km_op = [] if key_mask is None else [_bcast_key_mask(key_mask, b, h, n)]
 
     # ---- dq over k blocks (also emits delta = rowsum(do*o) for dkv) -------
     def kv_im(bhi, qb, kb, s):
@@ -431,15 +472,20 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
             [pl.BlockSpec((block_q, block_k), lambda bhi, qb, kb, s: (qb, kb))]
             if mask_np is not None else []
         ),
+        *(
+            [pl.BlockSpec((1, 1, block_k), lambda bhi, qb, kb, s: (bhi, 0, kb))]
+            if key_mask is not None else []
+        ),
         pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0)),
         pl.BlockSpec((1, block_q, d), lambda bhi, qb, kb, s: (bhi, qb, 0)),
         pl.BlockSpec((1, 1, block_q), lambda bhi, qb, kb, s: (bhi, 0, qb)),
     ]
-    dq_kernel = _with_optional_mask(
+    dq_kernel = _with_optional_masks(
         functools.partial(
             _bwd_dq_kernel, sm_scale=scale, block_q=block_q, block_k=block_k, nk=nk
         ),
         mask_np is not None,
+        key_mask is not None,
         n_out=2,
         n_scratch=2,
     )
@@ -460,7 +506,7 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
             pltpu.VMEM((block_q, LANES), jnp.float32),
         ],
         scalar=jnp.asarray(_scalar_table(visit)),
-        operands=[qf, kf, vf, *mask_op, dof, of, lsef],
+        operands=[qf, kf, vf, *mask_op, *km_op, dof, of, lsef],
         interpret=interpret,
         cost=_kernel_cost(visit, bh, block_q, block_k, d, 3,
                           2 * block_k, 4 * block_q, q.dtype.itemsize),
@@ -483,15 +529,20 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
             [pl.BlockSpec((block_q, block_k), lambda bhi, kb, qb, s: (qb, kb))]
             if mask_np is not None else []
         ),
+        *(
+            [pl.BlockSpec((1, 1, block_k), lambda bhi, kb, qb, s: (bhi, 0, kb))]
+            if key_mask is not None else []
+        ),
         pl.BlockSpec((1, block_q, d), q_im),
         pl.BlockSpec((1, 1, block_q), row_im),
         pl.BlockSpec((1, 1, block_q), row_im),
     ]
-    dkv_kernel = _with_optional_mask(
+    dkv_kernel = _with_optional_masks(
         functools.partial(
             _bwd_dkv_kernel, sm_scale=scale, block_q=block_q, block_k=block_k, nq=nq
         ),
         mask_np is not None,
+        key_mask is not None,
         n_out=2,
         n_scratch=2,
     )
@@ -512,12 +563,18 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         scalar=jnp.asarray(_scalar_table(visit_t)),
-        operands=[qf, kf, vf, *mask_op, dof, lsef, deltaf],
+        operands=[qf, kf, vf, *mask_op, *km_op, dof, lsef, deltaf],
         interpret=interpret,
         cost=_kernel_cost(visit_t, bh, block_q, block_k, d, 4,
                           2 * block_q, 4 * block_k, q.dtype.itemsize),
     )
-    return dq.reshape(b, h, n, d), dk.reshape(b, h, n, d), dv.reshape(b, h, n, d)
+    dkm = None if key_mask is None else np.zeros(key_mask.shape, jax.dtypes.float0)
+    return (
+        dq.reshape(b, h, n, d),
+        dk.reshape(b, h, n, d),
+        dv.reshape(b, h, n, d),
+        dkm,
+    )
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)

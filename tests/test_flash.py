@@ -166,6 +166,168 @@ def test_bfloat16_forward_close():
     )
 
 
+# --------------------------------------------------- runtime key-padding mask
+
+
+def _km_oracle(q, k, v, mask_np, km):
+    """Dense oracle with a runtime (b, n) key mask folded in. Rows whose
+    every key is masked follow the kernel's contract: exactly 0 output."""
+    scale = q.shape[-1] ** -0.5
+    allowed = jnp.asarray(mask_np)[None, None] & km[:, None, None, :]
+    out = dense_attend(q * scale, k, v, allowed)
+    live = jnp.any(allowed, axis=-1)[..., None]
+    return jnp.where(live, out, 0.0)
+
+
+def _rand_key_mask(key, b, n, fully_masked_batch=0):
+    km = jax.random.uniform(key, (b, n)) > 0.3
+    if fully_masked_batch is not None:
+        km = km.at[fully_masked_batch].set(False)
+    return km
+
+
+def test_key_mask_forward_parity():
+    """Ref attention.py:71-74 pad-mask semantics through the flash kernel:
+    random key masks, one batch with EVERY key masked (all rows -> 0)."""
+    b, h, n, d, block = 3, 2, 128, 64, 64
+    q, k, v = _qkv(jax.random.PRNGKey(10), b, h, n, d)
+    km = _rand_key_mask(jax.random.PRNGKey(11), b, n)
+    out = flash_attention(
+        q, k, v, key_mask=km, causal=True,
+        sm_scale=d**-0.5, block_q=block, block_k=block, interpret=True,
+    )
+    ref = _km_oracle(q, k, v, masks_lib.causal_mask(n), km)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    # the fully-masked batch is exactly zero
+    np.testing.assert_allclose(out[0], 0.0, atol=0.0)
+
+
+def test_key_mask_grad_parity():
+    b, h, n, d, block = 2, 2, 128, 64, 64
+    q, k, v = _qkv(jax.random.PRNGKey(12), b, h, n, d)
+    km = _rand_key_mask(jax.random.PRNGKey(13), b, n, fully_masked_batch=None)
+    # hand-mask a few single rows' entire key set via the causal prefix:
+    # key 0 masked makes row 0 fully masked
+    km = km.at[:, 0].set(False)
+    mask_np = masks_lib.causal_mask(n)
+
+    def f_flash(q, k, v):
+        o = flash_attention(
+            q, k, v, key_mask=km, causal=True,
+            sm_scale=d**-0.5, block_q=block, block_k=block, interpret=True,
+        )
+        return (o**2).sum()
+
+    def f_ref(q, k, v):
+        return (_km_oracle(q, k, v, mask_np, km) ** 2).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_flash, g_ref):
+        np.testing.assert_allclose(a, b_, atol=5e-4, rtol=5e-4)
+
+
+def test_key_mask_with_block_sparse_pattern():
+    """Key mask composes with a static sparse pattern (both stream through
+    the same kernel)."""
+    n, block = 128, 32
+    mask = masks_lib.block_sparse_mask(n, block_size=16, text_seq_len=32, seed=7)
+    q, k, v = _qkv(jax.random.PRNGKey(14), 2, 2, n, 64)
+    km = _rand_key_mask(jax.random.PRNGKey(15), 2, n, fully_masked_batch=None)
+    out = flash_attention(
+        q, k, v, key_mask=km, causal=True, pattern_mask=StaticMask(mask),
+        sm_scale=64**-0.5, block_q=block, block_k=block, interpret=True,
+    )
+    ref = _km_oracle(q, k, v, mask, km)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_key_mask_noncausal():
+    """CLIP's masked non-causal text encoder shape: no pattern operand at
+    all (analytic all-dense visit map) + runtime key mask."""
+    n, block = 256, 128
+    q, k, v = _qkv(jax.random.PRNGKey(16), 2, 2, n, 64)
+    km = _rand_key_mask(jax.random.PRNGKey(17), 2, n, fully_masked_batch=None)
+    out = flash_attention(
+        q, k, v, key_mask=km, causal=False,
+        sm_scale=64**-0.5, block_q=block, block_k=block, interpret=True,
+    )
+    ref = _km_oracle(q, k, v, np.ones((n, n), bool), km)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_key_mask_keeps_linear_memory():
+    """The VERDICT round-2 regression guard: a key-padding mask must NOT
+    bounce attention to the dense path — no (n, n)-shaped buffer may appear
+    anywhere in the lowered computation (fwd or bwd)."""
+    import re
+
+    b, h, n, d, block = 2, 2, 256, 64, 128
+    q, k, v = _qkv(jax.random.PRNGKey(18), b, h, n, d)
+    km = _rand_key_mask(jax.random.PRNGKey(19), b, n, fully_masked_batch=None)
+
+    def loss(q, k, v, km):
+        o = flash_attention(
+            q, k, v, key_mask=km, causal=True,
+            sm_scale=d**-0.5, block_q=block, block_k=block, interpret=True,
+        )
+        return (o**2).sum()
+
+    hlo = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, k, v, km).as_text()
+    square = re.compile(rf"\[(?:\d+,)*{n},{n}\]")
+    offenders = [l for l in hlo.split("\n") if square.search(l)]
+    assert not offenders, f"(n, n) buffers materialized:\n" + "\n".join(offenders[:5])
+
+
+def test_pattern_attention_masked_dispatches_flash(monkeypatch):
+    """ops/attention.py no longer gates the flash path on mask is None: a
+    masked full-causal PatternAttention must call flash_attention, and its
+    output must match the dense fallback."""
+    from dalle_pytorch_tpu.ops import attention as attention_mod
+
+    b, n, dim = 2, 128, 128
+    module = attention_mod.PatternAttention(
+        dim=dim, seq_len=n, attn_type="full", causal=True, heads=2, dim_head=64
+    )
+    x = jax.random.normal(jax.random.PRNGKey(20), (b, n, dim))
+    mask = _rand_key_mask(jax.random.PRNGKey(21), b, n, fully_masked_batch=None)
+    # keep row 0 live (bos-like): a fully-masked row would legitimately
+    # differ between flash (0) and dense fallback (uniform average)
+    mask = mask.at[:, 0].set(True)
+    params = module.init(jax.random.PRNGKey(0), x, mask=mask)
+
+    calls = []
+    real = attention_mod.flash_attention
+
+    def spy(*args, **kw):
+        calls.append(kw.get("key_mask"))
+        return real(*args, **kw)
+
+    monkeypatch.setattr(attention_mod, "flash_attention", spy)
+    out_flash = module.apply(params, x, mask=mask)
+    assert calls and calls[0] is not None, "masked call bypassed the flash kernel"
+
+    out_dense = module.apply(params, x, mask=mask, force_dense=True)
+    np.testing.assert_allclose(
+        np.asarray(out_flash), np.asarray(out_dense), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.slow
+def test_flagship_seq1280_key_mask_parity():
+    """Masked parity at the flagship seq 1280 (VERDICT round-2 item 1)."""
+    n, block = 1280, 128
+    q, k, v = _qkv(jax.random.PRNGKey(22), 1, 2, n, 64)
+    km = _rand_key_mask(jax.random.PRNGKey(23), 1, n, fully_masked_batch=None)
+    km = km.at[:, 0].set(True)
+    out = flash_attention(
+        q, k, v, key_mask=km, causal=True,
+        sm_scale=64**-0.5, block_q=block, block_k=block, interpret=True,
+    )
+    ref = _km_oracle(q, k, v, masks_lib.causal_mask(n), km)
+    np.testing.assert_allclose(out, ref, atol=3e-5, rtol=3e-5)
+
+
 @pytest.mark.slow
 def test_flagship_production_block_parity():
     """seq 1280 at the PRODUCTION block size (_flash_block(1280) — one
