@@ -83,7 +83,15 @@ def device_flops_per_step(batch: int, depth: int = DEPTH) -> float:
     dense += 3 * 2 * batch * DIM * (TEXT_SEQ * ext + IMAGE_FMAP**2 * NUM_IMAGE)
 
     block = _flash_block(n)
-    if block:
+    if block == n:
+        # packed single-block path: fwd 2 dots + ONE fused backward pass of
+        # 5 dots (s, dp, dq, dv, dk) = 7 per head, plus the in-kernel
+        # rotate-half P-dots (3 fwd + 6 bwd per head: q/k/v rotation in both
+        # passes and the inverse rotation of the three grads) — matches
+        # _fused_cost in ops/flash_attention.py
+        attn = depth * batch * HEADS * 7 * 2 * n * n * DIM_HEAD
+        attn += depth * batch * HEADS * 9 * 2 * n * DIM_HEAD * DIM_HEAD
+    elif block:
         visit = _block_visit_map(n // block, n // block, block, block, True, None)
         live = int((visit > 0).sum())
         # fwd 2 dots + dq 3 (s, dp, dq) + dkv 4 (s, dv, dp, dk) = 9

@@ -19,7 +19,10 @@ import jax.numpy as jnp
 import numpy as np
 import flax.linen as nn
 
+import functools
+
 from ..ops.attention import PatternAttention
+from ..ops.flash_attention import StaticTable
 from ..ops.layers import (
     FeedForward,
     GMLPBlock,
@@ -40,6 +43,14 @@ def cast_tuple(val, depth: int = 1) -> tuple:
     if isinstance(val, list):
         val = tuple(val)
     return val if isinstance(val, tuple) else (val,) * depth
+
+
+@functools.lru_cache(maxsize=None)
+def _interned_rotary(data: bytes, shape: tuple) -> StaticTable:
+    """Content-interned StaticTable: setup() runs on every init/apply, and
+    the fused attention kernel hashes tables by id — interning keeps the
+    id stable across traces so nothing retraces or recompiles."""
+    return StaticTable(np.frombuffer(data, dtype=np.float32).reshape(shape))
 
 
 class Transformer(nn.Module):
@@ -243,7 +254,13 @@ class Transformer(nn.Module):
         decode: bool = False,
     ) -> jnp.ndarray:
         rot_np = self.rotary_table()
-        rot = jnp.asarray(rot_np) if rot_np is not None else None
+        # a content-interned StaticTable, not a traced array: the attention
+        # layer materializes it for the unfused/decode paths and consumes it
+        # statically in the fused kernel — one source of truth for both
+        rot = (
+            _interned_rotary(rot_np.astype(np.float32).tobytes(), rot_np.shape)
+            if rot_np is not None else None
+        )
 
         if (
             self.pp_axis is not None

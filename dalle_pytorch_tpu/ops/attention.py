@@ -34,7 +34,13 @@ import flax.linen as nn
 import functools
 
 from . import masks as masks_lib
-from .flash_attention import StaticMask, flash_attention
+from .flash_attention import (
+    StaticMask,
+    StaticTable,
+    flash_attention,
+    fused_qkv_attention,
+    fused_qkv_supported,
+)
 from .layers import stable_softmax
 from .rotary import apply_rotary_emb
 
@@ -44,6 +50,13 @@ def _cached_flash_mask(module: "PatternAttention", n: int) -> StaticMask:
     """One StaticMask per (module config, n) — flax modules are frozen
     hashable dataclasses, so this builds each layer's mask exactly once."""
     return StaticMask(module.pattern_mask()[:n, :n])
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_rot_slice(table: StaticTable, n: int) -> StaticTable:
+    """Stable-identity [:n] slice of a static rotary table (the fused
+    kernel hashes tables by id)."""
+    return StaticTable(table.table[:n])
 
 
 def _flash_block(n: int) -> int:
@@ -164,28 +177,70 @@ class PatternAttention(nn.Module):
             dtype=self.dtype, param_dtype=self.param_dtype,
         )
         qkv = dense(inner * 3, False, "to_qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        # the rotary table may arrive as a StaticTable (the Transformer's
+        # single source of truth): the fused kernel consumes it statically,
+        # every other path materializes the SAME table here — the two can
+        # never diverge
+        rot_static = (
+            rotary_pos_emb if isinstance(rotary_pos_emb, StaticTable) else None
+        )
+        if rot_static is not None:
+            rotary_pos_emb = jnp.asarray(rot_static.table)
 
         if decode:
             # decode stays in (b, n, h, d) end to end: the K/V caches live
             # n-major, so the cache-wide dots stream (L, h*d) rows and the
             # per-step head transposes disappear entirely
-            q, k, v = (t.reshape(b, n, h, d) for t in (q, k, v))
+            q, k, v = (t.reshape(b, n, h, d) for t in jnp.split(qkv, 3, axis=-1))
             out = self._decode_attend(q, k, v, mask, rotary_pos_emb)
             out = out.reshape(b, n, inner)
         else:
-            q, k, v = (t.reshape(b, n, h, d).transpose(0, 2, 1, 3) for t in (q, k, v))
+            from ..parallel.context import sp_extent
+
+            use_sp = (
+                not force_dense
+                and not self.is_initializing()
+                and sp_extent(self.sp_axis) > 1
+            )
+            # packed single-block path: q/k/v head slices stream straight
+            # out of the projection layout, rotary applied in-kernel — no
+            # split/reshape/transpose/rotary sweeps through HBM
+            if (
+                not use_sp
+                and self.use_flash
+                and not force_dense
+                and self.attn_type in ("full", "sparse")
+                and _flash_block(n) == n
+                and fused_qkv_supported(n, h, d)
+                and (rotary_pos_emb is None or rot_static is not None)
+            ):
+                pattern = (
+                    _cached_flash_mask(self, n)
+                    if self.attn_type == "sparse" else None
+                )
+                rot = (
+                    _cached_rot_slice(rot_static, n)
+                    if rot_static is not None else None
+                )
+                out = fused_qkv_attention(
+                    qkv,
+                    None if mask is None else mask[:, :n],
+                    h, d, rot, self.causal, pattern, d**-0.5,
+                    jax.devices()[0].platform != "tpu",
+                )
+                out = dense(self.dim, True, "to_out")(out)
+                return nn.Dropout(self.dropout)(out, deterministic=deterministic)
+
+            q, k, v = (
+                t.reshape(b, n, h, d).transpose(0, 2, 1, 3)
+                for t in jnp.split(qkv, 3, axis=-1)
+            )
             if rotary_pos_emb is not None:
                 table = rotary_pos_emb[:n][None, None]  # (1, 1, n, rot)
                 q, k, v = (apply_rotary_emb(table, t) for t in (q, k, v))
 
-            from ..parallel.context import sp_extent
-
-            if (
-                not force_dense
-                and not self.is_initializing()
-                and sp_extent(self.sp_axis) > 1
-            ):
+            if use_sp:
                 out = self._sp_attend(q, k, v, mask, n)
             elif (
                 self.use_flash

@@ -220,6 +220,54 @@ def _bwd_dq_kernel(
         delta_ref[0] = jax.lax.transpose(delta_scr[:, 0:1], (1, 0))
 
 
+def _bwd_fused_kernel(
+    scalar_ref, q_ref, k_ref, v_ref, mask_ref, kmask_ref, do_ref, o_ref, lse_ref,
+    dq_ref, dk_ref, dv_ref,
+    *, sm_scale, block_q, block_k,
+):
+    """Single-block backward (nq == nk == 1): the whole row fits one grid
+    step, so dq, dk and dv come out of ONE score recomputation — 5 block
+    dots (s, dp, dq, dv, dk) instead of the split kernels' 7 (the dq and
+    dkv passes each re-derive s). At the flagship seq-1280 whole-row block
+    this is the production backward; the split kernels remain for tiled
+    grids, where dq accumulates over the inner k dimension while dk/dv
+    need the transposed iteration order. delta = rowsum(do*o) is computed
+    in-register — never written to HBM at all."""
+    visit = scalar_ref[0, 0]
+
+    @pl.when(visit > 0)
+    def _():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = _masked_scores(
+            q, k, sm_scale, mask_ref, kmask_ref, visit, 0, 0, block_q, block_k,
+        )
+        p = _masked_exp(s, _row_vec(lse_ref))
+        dv_ref[0] = jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(dv_ref.dtype)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        dq_ref[0] = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ).astype(dq_ref.dtype)
+        dk_ref[0] = jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ).astype(dk_ref.dtype)
+
+    @pl.when(visit == 0)
+    def _():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+
 def _bwd_dkv_kernel(
     scalar_ref, q_ref, k_ref, v_ref, mask_ref, kmask_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_scr, dv_scr,
@@ -460,6 +508,71 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
     mask_op = [] if mask_np is None else [jnp.asarray(mask_np, jnp.int8)]
     km_op = [] if key_mask is None else [_bcast_key_mask(key_mask, b, h, n)]
 
+    # ---- single-block fast path: one fused kernel, 5 dots instead of 7 ----
+    if nq == 1 and nk == 1:
+        def whole(bhi, qb, kb, s):
+            return (bhi, 0, 0)
+
+        row = whole
+
+        fused_specs = [
+            pl.BlockSpec((1, block_q, d), whole),
+            pl.BlockSpec((1, block_k, d), whole),
+            pl.BlockSpec((1, block_k, d), whole),
+            *(
+                [pl.BlockSpec((block_q, block_k), lambda bhi, qb, kb, s: (0, 0))]
+                if mask_np is not None else []
+            ),
+            *(
+                [pl.BlockSpec((1, 1, block_k), row)]
+                if key_mask is not None else []
+            ),
+            pl.BlockSpec((1, block_q, d), whole),
+            pl.BlockSpec((1, block_q, d), whole),
+            pl.BlockSpec((1, 1, block_q), row),
+        ]
+        fused_kernel = _with_optional_masks(
+            functools.partial(
+                _bwd_fused_kernel, sm_scale=scale,
+                block_q=block_q, block_k=block_k,
+            ),
+            mask_np is not None,
+            key_mask is not None,
+            n_out=3,
+            n_scratch=0,
+        )
+        dq, dk, dv = _call(
+            fused_kernel,
+            grid=(bh, 1, 1),
+            in_specs=fused_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), whole),
+                pl.BlockSpec((1, block_k, d), whole),
+                pl.BlockSpec((1, block_k, d), whole),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, n, d), q.dtype),
+            ],
+            scratch=[],
+            scalar=jnp.asarray(_scalar_table(visit)),
+            operands=[qf, kf, vf, *mask_op, *km_op, dof, of, lsef],
+            interpret=interpret,
+            cost=_kernel_cost(visit, bh, block_q, block_k, d, 5,
+                              0, 7 * block_q, q.dtype.itemsize),
+        )
+        dkm = (
+            None if key_mask is None
+            else np.zeros(key_mask.shape, jax.dtypes.float0)
+        )
+        return (
+            dq.reshape(b, h, n, d),
+            dk.reshape(b, h, n, d),
+            dv.reshape(b, h, n, d),
+            dkm,
+        )
+
     # ---- dq over k blocks (also emits delta = rowsum(do*o) for dkv) -------
     def kv_im(bhi, qb, kb, s):
         return (bhi, kb, 0)
@@ -578,3 +691,374 @@ def _bwd_rule(causal, pattern_mask, sm_scale, block_q, block_k, interpret, res, 
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+# ===================================================================== fused
+# Packed-qkv single-block path: consumes the attention projection's raw
+# (b, n, 3*h*d) output directly and emits (b, n, h*d), with the DALL-E
+# rotary rotation applied INSIDE the kernel. This deletes, per layer and
+# per direction, the qkv split, three (b, n, h, d) reshapes, three
+# (0, 2, 1, 3) transposes and three rotary HBM sweeps (measured ~8 ms/step
+# at the flagship config) — the kernel reads head slices straight out of
+# the projection layout. Mosaic requires a block's minor dim to be a
+# multiple of 128, so the grid processes ceil(128/d) heads per step
+# (2 for the flagship d=64), statically unrolled in the kernel body.
+# Single-block only (n == block): the production dispatch for seq <= 1280;
+# tiled grids keep the per-head kernels above.
+
+
+class StaticTable:
+    """Hashable id-wrapper for a static (n, rot_width) numpy angle table.
+    Registered as an EMPTY pytree (all data in the static aux): one object
+    serves as the single source of truth for rotary angles on every path —
+    it rides through traced kwargs (remat closures, shard_map bodies) as a
+    static leaf, the fused kernel consumes it directly, and the unfused /
+    decode paths materialize it with jnp.asarray — so the fused and
+    fallback paths cannot silently apply different tables."""
+
+    def __init__(self, table):
+        self.table = np.asarray(table, dtype=np.float32)
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+
+jax.tree_util.register_pytree_node(
+    StaticTable, lambda t: ((), t), lambda aux, _: aux
+)
+
+
+def _rot_tables(rot, n, d, dtype):
+    """cos/sin operands (n, d) in the compute dtype. The angle table is
+    zero-padded to the head dim (zero angle = identity rotation), and the
+    angles are cast to the compute dtype BEFORE cos/sin — exactly matching
+    apply_rotary_emb's `angle_table.astype(t.dtype)` (ops/rotary.py:82) so
+    the fused path is bit-compatible with the unfused one at f32."""
+    table = rot.table
+    assert table.shape[0] >= n, (table.shape, n)
+    table = table[:n]
+    if table.shape[1] < d:
+        table = np.pad(table, ((0, 0), (0, d - table.shape[1])))
+    ang = jnp.asarray(table).astype(dtype)
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _rot_block(t, cos, sin, P):
+    """In-kernel rotary: t*cos + rotate_half(t)*sin via the P-matrix dot.
+    f32 accumulation (Mosaic requires 32-bit matmul acc); every product is
+    an exact signed copy, so the rounding back to the input dtype matches
+    the out-of-kernel rotate_half bitwise."""
+    return t * cos + jax.lax.dot_general(
+        t, P, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(t.dtype) * sin
+
+
+def _inv_rot_block(t, cosf, sinf, Pf):
+    """VJP of _rot_block = rotation by -theta: the rotation is orthogonal
+    (P^T = -P, and sin/cos are constant within each rotation pair)."""
+    return t * cosf - jax.lax.dot_general(
+        t, Pf, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sinf
+
+
+def _fused_qkv_fwd_kernel(
+    q_ref, k_ref, v_ref, mask_ref, kmask_ref, cos_ref, sin_ref, p_ref, o_ref, lse_ref,
+    *, sm_scale, causal, d, hpb,
+):
+    outs = []
+    for j in range(hpb):
+        sl = slice(j * d, (j + 1) * d)
+        q, k, v = q_ref[0][:, sl], k_ref[0][:, sl], v_ref[0][:, sl]
+        if cos_ref is not None:
+            cos, sin, P = cos_ref[:], sin_ref[:], p_ref[:].astype(q.dtype)
+            q, k, v = (_rot_block(t, cos, sin, P) for t in (q, k, v))
+        n = q.shape[0]
+        s = _masked_scores(
+            q, k, sm_scale, mask_ref, kmask_ref,
+            1 if causal else 2, 0, 0, n, n,
+        )
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = _masked_exp(s, m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) / l_safe
+        outs.append(o.astype(o_ref.dtype))
+        lse_ref[0, j] = jax.lax.transpose(m + jnp.log(l_safe), (1, 0))
+    o_ref[0] = outs[0] if hpb == 1 else jnp.concatenate(outs, axis=-1)
+
+
+def _fused_qkv_bwd_kernel(
+    q_ref, k_ref, v_ref, mask_ref, kmask_ref, cos_ref, sin_ref, p_ref,
+    do_ref, o_ref, lse_ref, dq_ref, dk_ref, dv_ref,
+    *, sm_scale, causal, d, hpb,
+):
+    dqs, dks, dvs = [], [], []
+    for j in range(hpb):
+        sl = slice(j * d, (j + 1) * d)
+        q, k, v = q_ref[0][:, sl], k_ref[0][:, sl], v_ref[0][:, sl]
+        do = do_ref[0][:, sl]
+        if cos_ref is not None:
+            cos, sin, P = cos_ref[:], sin_ref[:], p_ref[:].astype(q.dtype)
+            q, k, v = (_rot_block(t, cos, sin, P) for t in (q, k, v))
+        n = q.shape[0]
+        s = _masked_scores(
+            q, k, sm_scale, mask_ref, kmask_ref,
+            1 if causal else 2, 0, 0, n, n,
+        )
+        lse_row = jax.lax.transpose(lse_ref[0, j], (1, 0))  # (n, 1)
+        p = _masked_exp(s, lse_row)
+        dv_h = jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o_ref[0][:, sl].astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        dq_h = jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dk_h = jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if cos_ref is not None:
+            cosf, sinf = cos_ref[:].astype(jnp.float32), sin_ref[:].astype(jnp.float32)
+            Pf = p_ref[:].astype(jnp.float32)
+            dq_h, dk_h, dv_h = (
+                _inv_rot_block(t, cosf, sinf, Pf) for t in (dq_h, dk_h, dv_h)
+            )
+        dqs.append(dq_h.astype(dq_ref.dtype))
+        dks.append(dk_h.astype(dk_ref.dtype))
+        dvs.append(dv_h.astype(dv_ref.dtype))
+    dq_ref[0] = dqs[0] if hpb == 1 else jnp.concatenate(dqs, axis=-1)
+    dk_ref[0] = dks[0] if hpb == 1 else jnp.concatenate(dks, axis=-1)
+    dv_ref[0] = dvs[0] if hpb == 1 else jnp.concatenate(dvs, axis=-1)
+
+
+def _call_plain(kernel, grid, in_specs, out_specs, out_shape, operands, interpret, cost):
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",) * len(grid),
+            # the head-group backward holds several (n, n) f32 temporaries
+            # at once (s, p, dp, ds); the default 16 MiB scoped-vmem budget
+            # is exceeded at n=1280 x 2 heads — v5e has 128 MiB physical
+            vmem_limit_bytes=100 * 1024 * 1024,
+        ),
+        cost_estimate=cost,
+        interpret=interpret,
+    )(*operands)
+
+
+def fused_qkv_supported(n, heads, dim_head):
+    """The packed path needs a lane-aligned whole-row block that fits VMEM
+    (the backward holds several (n, n) f32 temporaries at once) and
+    128-aligned head groups."""
+    hpb = max(1, 128 // dim_head)
+    return (
+        n % 128 == 0
+        and n <= 2048
+        and (dim_head * hpb) % 128 == 0
+        and heads % hpb == 0
+        and (heads * dim_head) % 128 == 0
+    )
+
+
+def _fused_prep(qkv, key_mask, heads, dim_head, rot, pattern_mask):
+    b, n, thd = qkv.shape
+    d, h = dim_head, heads
+    assert thd == 3 * h * d, (qkv.shape, heads, dim_head)
+    hpb = max(1, 128 // d)
+    assert fused_qkv_supported(n, h, d)
+    mask_np = None
+    if pattern_mask is not None:
+        assert isinstance(pattern_mask, StaticMask)
+        mask_np = pattern_mask.mask
+        assert mask_np.shape == (n, n)
+    mask_op, mask_spec = [], []
+    if mask_np is not None:
+        mask_op = [jnp.asarray(mask_np, jnp.int8)]
+        mask_spec = [pl.BlockSpec((n, n), lambda bi, g: (0, 0))]
+    km_op, km_spec = [], []
+    if key_mask is not None:
+        assert key_mask.shape == (b, n), (key_mask.shape, (b, n))
+        km_op = [key_mask[:, None, :].astype(jnp.int32)]
+        km_spec = [pl.BlockSpec((1, 1, n), lambda bi, g: (bi, 0, 0))]
+    rot_op, rot_spec = [], []
+    if rot is not None:
+        cos, sin = _rot_tables(rot, n, d, qkv.dtype)
+        from .rotary import _rotate_half_matrix
+
+        rot_op = [cos, sin, jnp.asarray(_rotate_half_matrix(d))]
+        rot_spec = [pl.BlockSpec((n, d), lambda bi, g: (0, 0))] * 2 + [
+            pl.BlockSpec((d, d), lambda bi, g: (0, 0))
+        ]
+    return b, n, d, h, hpb, mask_op, mask_spec, km_op, km_spec, rot_op, rot_spec
+
+
+def _fused_cost(b, n, d, h, dots, rot_dots, dtype_bytes):
+    """``dots`` big (n, n, d) block dots + ``rot_dots`` rotate-half
+    (n, d, d) P-dots per head (fwd: q/k/v rotation = 3; bwd: those plus the
+    inverse rotation of the three gradients = 9 total across both)."""
+    return pl.CostEstimate(
+        flops=b * h * (dots * 2 * n * n * d + rot_dots * 2 * n * d * d),
+        transcendentals=b * h * n * n,
+        bytes_accessed=b * h * n * d * dtype_bytes * (3 + dots),
+    )
+
+
+def _fused_unpack(kernel, n_extra, mask_op, km_op, rot_op, **static):
+    """Positional-ref adapter shared by the fused fwd/bwd pallas bodies:
+    q/k/v, then the optional (pattern, key-mask, cos/sin/P) operands, then
+    ``n_extra`` trailing inputs (bwd: do, o, lse), then the outputs."""
+
+    def wrapped(*refs):
+        split = 3 + len(mask_op) + len(km_op) + len(rot_op) + n_extra
+        ins = list(refs[:split])
+        outs = refs[split:]
+        fixed, rest = ins[:3], ins[3:]
+        mr = rest.pop(0) if mask_op else None
+        kmr = rest.pop(0) if km_op else None
+        cr = rest.pop(0) if rot_op else None
+        sr = rest.pop(0) if rot_op else None
+        pr = rest.pop(0) if rot_op else None
+        return kernel(*fixed, mr, kmr, cr, sr, pr, *rest, *outs, **static)
+
+    return wrapped
+
+
+def _fused_qkv_fwd(qkv, key_mask, heads, dim_head, rot, causal, pattern_mask, sm_scale, interpret):
+    (b, n, d, h, hpb, mask_op, mask_spec, km_op, km_spec, rot_op, rot_spec) = (
+        _fused_prep(qkv, key_mask, heads, dim_head, rot, pattern_mask)
+    )
+    scale = d**-0.5 if sm_scale is None else sm_scale
+    g = h // hpb
+    w = hpb * d  # block width (a multiple of 128)
+    hd = h * d
+
+    def q_im(bi, gi):
+        return (bi, 0, gi)
+
+    def k_im(bi, gi):
+        return (bi, 0, g + gi)
+
+    def v_im(bi, gi):
+        return (bi, 0, 2 * g + gi)
+
+    in_specs = [
+        pl.BlockSpec((1, n, w), q_im),
+        pl.BlockSpec((1, n, w), k_im),
+        pl.BlockSpec((1, n, w), v_im),
+        *mask_spec, *km_spec, *rot_spec,
+    ]
+    wrapped = _fused_unpack(
+        _fused_qkv_fwd_kernel, 0, mask_op, km_op, rot_op,
+        sm_scale=scale, causal=causal, d=d, hpb=hpb,
+    )
+
+    o, lse = _call_plain(
+        wrapped,
+        grid=(b, g),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, n, w), lambda bi, gi: (bi, 0, gi)),
+            pl.BlockSpec((1, hpb, 1, n), lambda bi, gi: (bi, gi, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, hd), qkv.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, n), jnp.float32),
+        ],
+        operands=[qkv, qkv, qkv, *mask_op, *km_op, *rot_op],
+        interpret=interpret,
+        cost=_fused_cost(b, n, d, h, 2, 3 if rot_op else 0, qkv.dtype.itemsize),
+    )
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7, 8))
+def fused_qkv_attention(
+    qkv, key_mask, heads, dim_head, rot=None, causal=True,
+    pattern_mask=None, sm_scale=None, interpret=False,
+):
+    """Packed single-block attention: (b, n, 3*h*d) -> (b, n, h*d), rotary
+    (q, k AND v — the reference's quirk, attention.py:63-64) applied inside
+    the kernel from the static angle table ``rot`` (StaticTable). Covers
+    the reference's dense causal + pad-mask semantics (attention.py:39-86)
+    in the projection's own layout: no split/reshape/transpose ops touch
+    HBM between the qkv projection and the output projection."""
+    o, _ = _fused_qkv_fwd(
+        qkv, key_mask, heads, dim_head, rot, causal, pattern_mask, sm_scale, interpret
+    )
+    return o
+
+
+def _fused_fwd_rule(qkv, key_mask, heads, dim_head, rot, causal, pattern_mask, sm_scale, interpret):
+    o, lse = _fused_qkv_fwd(
+        qkv, key_mask, heads, dim_head, rot, causal, pattern_mask, sm_scale, interpret
+    )
+    return o, (qkv, key_mask, o, lse)
+
+
+def _fused_bwd_rule(heads, dim_head, rot, causal, pattern_mask, sm_scale, interpret, res, do):
+    qkv, key_mask, o, lse = res
+    (b, n, d, h, hpb, mask_op, mask_spec, km_op, km_spec, rot_op, rot_spec) = (
+        _fused_prep(qkv, key_mask, heads, dim_head, rot, pattern_mask)
+    )
+    scale = d**-0.5 if sm_scale is None else sm_scale
+    g = h // hpb
+    w = hpb * d
+    hd = h * d
+
+    in_specs = [
+        pl.BlockSpec((1, n, w), lambda bi, gi: (bi, 0, gi)),
+        pl.BlockSpec((1, n, w), lambda bi, gi: (bi, 0, g + gi)),
+        pl.BlockSpec((1, n, w), lambda bi, gi: (bi, 0, 2 * g + gi)),
+        *mask_spec, *km_spec, *rot_spec,
+        pl.BlockSpec((1, n, w), lambda bi, gi: (bi, 0, gi)),
+        pl.BlockSpec((1, n, w), lambda bi, gi: (bi, 0, gi)),
+        pl.BlockSpec((1, hpb, 1, n), lambda bi, gi: (bi, gi, 0, 0)),
+    ]
+
+    wrapped = _fused_unpack(
+        _fused_qkv_bwd_kernel, 3, mask_op, km_op, rot_op,
+        sm_scale=scale, causal=causal, d=d, hpb=hpb,
+    )
+
+    dq, dk, dv = _call_plain(
+        wrapped,
+        grid=(b, g),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, n, w), lambda bi, gi: (bi, 0, gi)),
+            pl.BlockSpec((1, n, w), lambda bi, gi: (bi, 0, gi)),
+            pl.BlockSpec((1, n, w), lambda bi, gi: (bi, 0, gi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, n, hd), qkv.dtype),
+            jax.ShapeDtypeStruct((b, n, hd), qkv.dtype),
+            jax.ShapeDtypeStruct((b, n, hd), qkv.dtype),
+        ],
+        operands=[qkv, qkv, qkv, *mask_op, *km_op, *rot_op, do, o, lse],
+        interpret=interpret,
+        cost=_fused_cost(b, n, d, h, 5, 6 if rot_op else 0, qkv.dtype.itemsize),
+    )
+    dqkv = jnp.concatenate((dq, dk, dv), axis=-1)
+    dkm = None if key_mask is None else np.zeros(key_mask.shape, jax.dtypes.float0)
+    return (dqkv, dkm)
+
+
+fused_qkv_attention.defvjp(_fused_fwd_rule, _fused_bwd_rule)

@@ -297,19 +297,119 @@ def test_pattern_attention_masked_dispatches_flash(monkeypatch):
     params = module.init(jax.random.PRNGKey(0), x, mask=mask)
 
     calls = []
-    real = attention_mod.flash_attention
+    real_flash = attention_mod.flash_attention
+    real_fused = attention_mod.fused_qkv_attention
 
-    def spy(*args, **kw):
+    def spy_flash(*args, **kw):
         calls.append(kw.get("key_mask"))
-        return real(*args, **kw)
+        return real_flash(*args, **kw)
 
-    monkeypatch.setattr(attention_mod, "flash_attention", spy)
+    def spy_fused(qkv, key_mask, *args, **kw):
+        calls.append(key_mask)
+        return real_fused(qkv, key_mask, *args, **kw)
+
+    monkeypatch.setattr(attention_mod, "flash_attention", spy_flash)
+    monkeypatch.setattr(attention_mod, "fused_qkv_attention", spy_fused)
     out_flash = module.apply(params, x, mask=mask)
     assert calls and calls[0] is not None, "masked call bypassed the flash kernel"
 
     out_dense = module.apply(params, x, mask=mask, force_dense=True)
     np.testing.assert_allclose(
         np.asarray(out_flash), np.asarray(out_dense), atol=2e-5, rtol=2e-5
+    )
+
+
+# ------------------------------------------------- packed-qkv fused kernel
+
+
+def _rand_rotary(n, d, key):
+    """A pair-constant angle table like the real DALL-E one (repeat-2
+    structure is what makes the in-kernel inverse rotation valid)."""
+    from dalle_pytorch_tpu.ops.flash_attention import StaticTable
+
+    half = jax.random.normal(key, (n, d // 2))
+    table = jnp.repeat(half, 2, axis=-1)
+    return StaticTable(np.asarray(table))
+
+
+def test_fused_qkv_matches_unfused_through_transformer():
+    """The packed single-block path (split/reshape/transpose/rotary all
+    inside the kernel) must match the dense reference path through a real
+    Transformer — forward AND parameter gradients, with and without a
+    key-padding mask, rotary on."""
+    from dalle_pytorch_tpu.models.transformer import Transformer
+
+    kw = dict(dim=128, depth=2, seq_len=128, causal=True, heads=2, dim_head=64,
+              image_fmap_size=8, rotary_emb=True)
+    tr = Transformer(**kw)
+    tr_dense = Transformer(**kw, use_flash=False)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 128, 128))
+    mask = (jax.random.uniform(jax.random.PRNGKey(1), (2, 128)) > 0.3).at[:, 0].set(True)
+    params = tr.init(jax.random.PRNGKey(2), x)
+
+    import dalle_pytorch_tpu.ops.attention as A
+    calls = []
+    real = A.fused_qkv_attention
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    A.fused_qkv_attention = spy
+    try:
+        for m in (None, mask):
+            np.testing.assert_allclose(
+                np.asarray(tr.apply(params, x, mask=m)),
+                np.asarray(tr_dense.apply(params, x, mask=m)),
+                atol=3e-4, rtol=3e-4,
+            )
+            gf = jax.tree_util.tree_leaves(
+                jax.grad(lambda p: (tr.apply(p, x, mask=m) ** 2).sum())(params)
+            )
+            gd = jax.tree_util.tree_leaves(
+                jax.grad(lambda p: (tr_dense.apply(p, x, mask=m) ** 2).sum())(params)
+            )
+            for a, b in zip(gf, gd):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-3)
+    finally:
+        A.fused_qkv_attention = real
+    assert calls, "fused path never dispatched"
+
+
+def test_fused_qkv_direct_parity():
+    """fused_qkv_attention vs the per-head pipeline it replaces: split ->
+    (b, h, n, d) -> rotary on q, k AND v -> masked dense attention."""
+    from dalle_pytorch_tpu.ops.flash_attention import fused_qkv_attention
+    from dalle_pytorch_tpu.ops.rotary import apply_rotary_emb
+
+    b, n, h, d = 2, 128, 2, 64
+    qkv = jax.random.normal(jax.random.PRNGKey(3), (b, n, 3 * h * d))
+    km = _rand_key_mask(jax.random.PRNGKey(4), b, n, fully_masked_batch=None)
+    km = km.at[:, 0].set(True)
+    rot = _rand_rotary(n, d, jax.random.PRNGKey(5))
+
+    def reference(qkv):
+        q, k, v = (t.reshape(b, n, h, d).transpose(0, 2, 1, 3)
+                   for t in jnp.split(qkv, 3, axis=-1))
+        table = jnp.asarray(rot.table)[None, None]
+        q, k, v = (apply_rotary_emb(table, t) for t in (q, k, v))
+        allowed = jnp.asarray(masks_lib.causal_mask(n))[None, None] & km[:, None, None, :]
+        out = dense_attend(q * d**-0.5, k, v, allowed)
+        return out.transpose(0, 2, 1, 3).reshape(b, n, h * d)
+
+    def fused(qkv):
+        return fused_qkv_attention(
+            qkv, km, h, d, rot, True, None, d**-0.5, True
+        )
+
+    np.testing.assert_allclose(
+        np.asarray(fused(qkv)), np.asarray(reference(qkv)), atol=2e-5, rtol=2e-5
+    )
+    cot = jax.random.normal(jax.random.PRNGKey(6), (b, n, h * d))
+    g_fused = jax.grad(lambda q_: (fused(q_) * cot).sum())(qkv)
+    g_ref = jax.grad(lambda q_: (reference(q_) * cot).sum())(qkv)
+    np.testing.assert_allclose(
+        np.asarray(g_fused), np.asarray(g_ref), atol=5e-4, rtol=5e-4
     )
 
 
