@@ -137,14 +137,6 @@ class Transformer(nn.Module):
                 f"moe_every must be >= 1 (every n-th FF becomes an expert "
                 f"layer); got {self.moe_every}"
             )
-        if self.ff_experts > 0 and (self.reversible or self.remat):
-            raise ValueError(
-                "MoE feed-forwards cannot run under reversible/remat "
-                "execution: those paths apply blocks through detached "
-                "closures where the Switch load-balance sow() is silently "
-                "dropped; use the sequential mode"
-            )
-
         attn_blocks, ff_blocks, kinds = [], [], []
         for ind in range(self.depth):
             attn_type = attn_types[ind % len(attn_types)]
@@ -294,16 +286,28 @@ class Transformer(nn.Module):
                 x2 = x2 + self.ff_blocks[ind](x1, **fkw)
             return (x1 + x2) / 2
 
-        # pure-function paths: remat or reversible training
+        # pure-function paths: remat or reversible training. Block closures
+        # return (delta, aux); the Switch load-balance loss rides the aux
+        # channel (re-sown below) so MoE composes with O(1)-memory execution.
         fns, params, kwargs = self._pure_blocks(mask, rot, deterministic)
 
         if self.remat and not self.reversible:
+            aux = jnp.zeros((), jnp.float32)
             for (f, g), (pf, pg), (kwf, kwg) in zip(fns, params, kwargs):
-                x = x + jax.checkpoint(f)(pf, x, kwf)
-                x = x + jax.checkpoint(g)(pg, x, kwg)
+                d, a = jax.checkpoint(f)(pf, x, kwf)
+                x = x + d
+                dg, ag = jax.checkpoint(g)(pg, x, kwg)
+                x = x + dg
+                aux = aux + a + ag
+            if self.ff_experts > 0:
+                self.sow("moe_aux", "load_balance", aux)
             return x
 
-        out = reversible_sequence(tuple(fns), params, jnp.concatenate((x, x), -1), kwargs)
+        out, aux = reversible_sequence(
+            tuple(fns), params, jnp.concatenate((x, x), -1), kwargs
+        )
+        if self.ff_experts > 0:
+            self.sow("moe_aux", "load_balance", aux)
         y1, y2 = jnp.split(out, 2, axis=-1)
         return (y1 + y2) / 2
 
@@ -330,8 +334,10 @@ class Transformer(nn.Module):
         if self.ff_experts > 0:
             raise ValueError(
                 "pipeline parallelism excludes MoE feed-forwards: the "
-                "dense/MoE layer alternation breaks stage homogeneity and "
-                "the load-balance sow() cannot cross the stage shard_map"
+                "dense/MoE layer alternation breaks stage homogeneity, and "
+                "the GPipe layer_fn drops the blocks' (delta, aux) aux "
+                "channel — lifting this guard requires threading aux "
+                "through the stage schedule"
             )
         if self.reversible:
             raise ValueError("pipeline parallelism excludes reversible mode")
@@ -391,8 +397,10 @@ class Transformer(nn.Module):
         )
 
         def layer_fn(p, t):
-            t = t + attn_f(p["attn"], t, akw)
-            return t + ff_f(p["ff"], t, fkw)
+            d, _ = attn_f(p["attn"], t, akw)
+            t = t + d
+            d, _ = ff_f(p["ff"], t, fkw)
+            return t + d
 
         if self.remat:
             # honor --remat inside the pipeline: recompute each layer's
@@ -438,7 +446,15 @@ class Transformer(nn.Module):
                         call_kwargs["mask"] = kw.get("mask")
                         call_kwargs["rotary_pos_emb"] = kw.get("rot")
                     rngs = {"dropout": kw["rng"]} if "rng" in kw else None
-                    return mod.apply({"params": p}, t, rngs=rngs, **call_kwargs)
+                    y, mut = mod.apply(
+                        {"params": p}, t, rngs=rngs, mutable=["moe_aux"],
+                        **call_kwargs,
+                    )
+                    aux = sum(
+                        jax.tree_util.tree_leaves(mut.get("moe_aux", {})),
+                        jnp.zeros((), jnp.float32),
+                    )
+                    return y, aux
 
                 return fn
 

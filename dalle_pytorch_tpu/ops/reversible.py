@@ -11,8 +11,12 @@ identical between forward and recompute (reversible.py:20-50), here each block
 receives an explicit PRNG key as part of its traced inputs, so the recompute
 is deterministic by construction.
 
-Blocks are pure functions ``fn(params, x, kwargs_tree) -> y``; the flax layer
-stack hands in unbound-module apply closures (models/transformer.py).
+Blocks are pure functions ``fn(params, x, kwargs_tree) -> (y, aux)`` where
+``aux`` is a scalar side-output (the Switch MoE load-balance loss; 0.0 for
+dense blocks). The sequence returns ``(out, total_aux)`` and the custom VJP
+threads the aux cotangent back through every block, so MoE layers train
+correctly under O(1)-memory execution — the reference's DeepSpeed analog
+cannot combine MoE with activation checkpointing of this kind at all.
 """
 
 from __future__ import annotations
@@ -23,7 +27,7 @@ from typing import Any, Callable, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-BlockFn = Callable[[Any, jnp.ndarray, Any], jnp.ndarray]
+BlockFn = Callable[[Any, jnp.ndarray, Any], Tuple[jnp.ndarray, jnp.ndarray]]
 
 
 def _split(x):
@@ -36,23 +40,29 @@ def reversible_sequence(
     params: Sequence[Tuple[Any, Any]],
     x: jnp.ndarray,
     kwargs: Sequence[Tuple[Any, Any]],
-) -> jnp.ndarray:
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Run ``x -> [x1; x2]`` through reversible blocks
-    (y1 = x1 + f(x2), y2 = x2 + g(y1)); input x is (b, n, 2d)."""
+    (y1 = x1 + f(x2), y2 = x2 + g(y1)); input x is (b, n, 2d).
+    Returns (output, summed aux side-outputs)."""
     x1, x2 = _split(x)
+    aux = jnp.zeros((), jnp.float32)
     for (f, g), (pf, pg), (kwf, kwg) in zip(fns, params, kwargs):
-        x1 = x1 + f(pf, x2, kwf)
-        x2 = x2 + g(pg, x1, kwg)
-    return jnp.concatenate((x1, x2), axis=-1)
+        df, af = f(pf, x2, kwf)
+        x1 = x1 + df
+        dg, ag = g(pg, x1, kwg)
+        x2 = x2 + dg
+        aux = aux + af + ag
+    return jnp.concatenate((x1, x2), axis=-1), aux
 
 
 def _fwd(fns, params, x, kwargs):
-    y = reversible_sequence(fns, params, x, kwargs)
-    return y, (params, y, kwargs)
+    y, aux = reversible_sequence(fns, params, x, kwargs)
+    return (y, aux), (params, y, kwargs)
 
 
-def _bwd(fns, res, dy):
+def _bwd(fns, res, cts):
     params, y, kwargs = res
+    dy, daux = cts
     y1, y2 = _split(y)
     dy1, dy2 = _split(dy)
 
@@ -60,14 +70,14 @@ def _bwd(fns, res, dy):
     for (f, g), (pf, pg), (kwf, kwg) in zip(
         reversed(fns), reversed(list(params)), reversed(list(kwargs))
     ):
-        g_out, g_vjp = jax.vjp(g, pg, y1, kwg)
+        (g_out, _), g_vjp = jax.vjp(g, pg, y1, kwg)
         x2 = y2 - g_out
-        dpg, dy1_from_g, dkwg = g_vjp(dy2)
+        dpg, dy1_from_g, dkwg = g_vjp((dy2, daux))
         dy1 = dy1 + dy1_from_g
 
-        f_out, f_vjp = jax.vjp(f, pf, x2, kwf)
+        (f_out, _), f_vjp = jax.vjp(f, pf, x2, kwf)
         x1 = y1 - f_out
-        dpf, dx2_from_f, dkwf = f_vjp(dy1)
+        dpf, dx2_from_f, dkwf = f_vjp((dy1, daux))
         dy2 = dy2 + dx2_from_f
 
         y1, y2 = x1, x2
@@ -83,9 +93,13 @@ reversible_sequence.defvjp(_fwd, _bwd)
 
 def reversible_forward_only(fns, params, x, kwargs):
     """The same wiring without the custom VJP — for eval / decode paths where
-    no gradient flows and XLA may fuse freely."""
+    no gradient flows and XLA may fuse freely. Returns (out, total_aux)."""
     x1, x2 = _split(x)
+    aux = jnp.zeros((), jnp.float32)
     for (f, g), (pf, pg), (kwf, kwg) in zip(fns, params, kwargs):
-        x1 = x1 + f(pf, x2, kwf)
-        x2 = x2 + g(pg, x1, kwg)
-    return jnp.concatenate((x1, x2), axis=-1)
+        df, af = f(pf, x2, kwf)
+        x1 = x1 + df
+        dg, ag = g(pg, x1, kwg)
+        x2 = x2 + dg
+        aux = aux + af + ag
+    return jnp.concatenate((x1, x2), axis=-1), aux

@@ -185,3 +185,76 @@ class TestDALLEMoE:
         seq = np.asarray(toks)
         assert seq.shape == (2, 16)
         assert (seq >= 0).all() and (seq < 32).all()
+
+
+class TestMoEMemoryModes:
+    """MoE must compose with O(1)-activation-memory execution: the Switch
+    aux loss rides the (delta, aux) channel of the pure-closure block fns
+    (ops/reversible.py) instead of sow, so remat/reversible training sees
+    the identical load-balance objective (VERDICT r3 ask #4)."""
+
+    def make(self, **kw):
+        return DALLE(
+            dim=32, depth=4, num_text_tokens=30, text_seq_len=6,
+            num_image_tokens=16, image_fmap_size=3, heads=2, dim_head=8,
+            attn_types=("full",), shift_tokens=False,
+            ff_experts=4, moe_every=2, **kw,
+        )
+
+    def batch(self):
+        rng = np.random.RandomState(0)
+        return (
+            jnp.asarray(rng.randint(1, 30, (2, 6)), jnp.int32),
+            jnp.asarray(rng.randint(0, 16, (2, 9)), jnp.int32),
+        )
+
+    def _run(self, model, params, text, image):
+        def loss_fn(p):
+            out, mut = model.apply(
+                {"params": p}, text, image, return_loss=True,
+                mutable=["moe_aux"],
+            )
+            aux = sum(jax.tree_util.tree_leaves(mut["moe_aux"]))
+            return out + 1e-2 * aux, (out, aux)
+
+        (_, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        return float(loss), float(aux), grads
+
+    def test_remat_matches_sequential_exactly(self):
+        text, image = self.batch()
+        seq = self.make()
+        params = seq.init(jax.random.key(0), text, image)["params"]
+        l0, a0, g0 = self._run(seq, params, text, image)
+        l1, a1, g1 = self._run(self.make(remat=True), params, text, image)
+        np.testing.assert_allclose(l0, l1, rtol=1e-6)
+        np.testing.assert_allclose(a0, a1, rtol=1e-5)
+        for a, e in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g0)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e), atol=1e-5, rtol=1e-3)
+
+    def test_reversible_trains_and_aux_reaches_gate(self):
+        text, image = self.batch()
+        rev = self.make(reversible=True)
+        params = rev.init(jax.random.key(0), text, image)["params"]
+        loss, aux, grads = self._run(rev, params, text, image)
+        assert np.isfinite(loss) and aux >= 1.0 - 1e-5
+        flat = jax.tree_util.tree_leaves(grads)
+        assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+        gate_g = grads["transformer"]["ff_1"]["fn"]["fn"]["gate"]["kernel"]
+        assert np.abs(np.asarray(gate_g)).max() > 0
+
+    def test_reversible_custom_vjp_forward_matches_direct_wiring(self):
+        """The custom-VJP primal (training path) must produce the same loss
+        and aux as the bound direct wiring (init path) on identical params."""
+        text, image = self.batch()
+        rev = self.make(reversible=True)
+        out, vars0 = jax.jit(
+            lambda k: rev.init_with_output(k, text, image, return_loss=True),
+        )(jax.random.key(0))
+        params = vars0["params"]
+        aux0 = sum(jax.tree_util.tree_leaves(vars0["moe_aux"]))
+        loss1, mut = rev.apply(
+            {"params": params}, text, image, return_loss=True, mutable=["moe_aux"]
+        )
+        aux1 = sum(jax.tree_util.tree_leaves(mut["moe_aux"]))
+        np.testing.assert_allclose(float(out), float(loss1), rtol=1e-5)
+        np.testing.assert_allclose(float(aux0), float(aux1), rtol=1e-5)
