@@ -127,11 +127,15 @@ class DALLE(nn.Module):
     # -------------------------------------------------------------- setup
 
     def setup(self):
-        self.text_emb = nn.Embed(
-            self.num_text_tokens_ext, self.dim, param_dtype=self.param_dtype
+        from ..ops.layers import serving_embed
+
+        self.text_emb = serving_embed(
+            self.serve_quant, self.num_text_tokens_ext, self.dim,
+            dtype=self.dtype, param_dtype=self.param_dtype,
         )
-        self.image_emb = nn.Embed(
-            self.num_image_tokens, self.dim, param_dtype=self.param_dtype
+        self.image_emb = serving_embed(
+            self.serve_quant, self.num_image_tokens, self.dim,
+            dtype=self.dtype, param_dtype=self.param_dtype,
         )
         if not self.rotary_emb:
             self.text_pos_emb = nn.Embed(

@@ -550,3 +550,15 @@ class PatternAttention(nn.Module):
         return jnp.einsum(
             "bhnl,blhd->bnhd", attn.astype(cached_value.value.dtype), cached_value.value
         )
+
+    # NOTE on int8 K/V caches (measured, v5e-1, 2026-07): quantizing the
+    # decode caches was tried two ways — int8 storage widened inside the
+    # cache dots (0.94 ms/token) and native s8xs8->s32 MXU dots with rowwise
+    # scales on q/K/attn/V (1.44 ms/token) — and BOTH lost to the plain
+    # bf16 cache (0.84 ms/token). Single-stream decode here is latency-bound
+    # on the serial op chain, not HBM-bound: the ~31 MB/step the int8 cache
+    # saves is worth ~40 us at HBM bandwidth, while the extra quantize /
+    # dequantize elementwise stages add more serial work than that to every
+    # one of the 1024 steps. The caches therefore stay bf16; int8 serving
+    # quantizes what decode is actually bound on — the weight matrices and
+    # embedding tables (utils/quantize.py).

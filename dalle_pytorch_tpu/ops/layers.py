@@ -118,6 +118,53 @@ class QuantDense(nn.Module):
         return y
 
 
+class QuantEmbed(nn.Module):
+    """int8 embedding table for serving: rows are stored int8 with a
+    per-row symmetric scale and dequantized after the gather, so the table
+    reads from HBM at half the bf16 bytes. Params come from
+    ``utils/quantize.py`` (training through this module is unsupported)."""
+
+    num_embeddings: int
+    features: int
+    dtype: Dtype = jnp.bfloat16
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids):
+        q = self.param(
+            "embedding_q",
+            lambda key, shape: jnp.zeros(shape, jnp.int8),
+            (self.num_embeddings, self.features),
+        )
+        scale = self.param(
+            "scale",
+            lambda key, shape: jnp.ones(shape, jnp.float32),
+            (self.num_embeddings,),
+        )
+        rows = jnp.take(q, ids, axis=0).astype(self.dtype)
+        s = jnp.take(scale, ids, axis=0).astype(self.dtype)
+        return rows * s[..., None]
+
+
+def serving_embed(
+    quant: bool,
+    num_embeddings: int,
+    features: int,
+    *,
+    name: Optional[str] = None,
+    dtype: Dtype = jnp.float32,
+    param_dtype: Dtype = jnp.float32,
+) -> nn.Module:
+    """``nn.Embed`` vs int8 ``QuantEmbed`` — the embedding analog of
+    ``serving_dense`` (same structural-parallelism contract)."""
+    if quant:
+        return QuantEmbed(
+            num_embeddings, features, name=name,
+            dtype=dtype, param_dtype=param_dtype,
+        )
+    return nn.Embed(num_embeddings, features, name=name, param_dtype=param_dtype)
+
+
 def serving_dense(
     quant: bool,
     features: int,

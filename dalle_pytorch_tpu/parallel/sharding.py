@@ -51,8 +51,11 @@ DEFAULT_RULES: Tuple[Tuple[str, P], ...] = (
      P("fsdp", "tp")),
     (r"(ff|attn|FeedForward|GMLPBlock)_\d+(/\w+)*/(Quant)?Dense_1/kernel(_q)?$",
      P("tp", "fsdp")),
-    # vocab-sized tensors: shard the vocab dim over fsdp, features over tp
-    (r"(text_emb|image_emb)/embedding$", P("fsdp", "tp")),
+    # vocab-sized tensors: shard the vocab dim over fsdp, features over tp;
+    # int8 serving renames embedding -> embedding_q with a per-row scale
+    # that shards along the same vocab dim
+    (r"(text_emb|image_emb)/embedding(_q)?$", P("fsdp", "tp")),
+    (r"(text_emb|image_emb)/scale$", P("fsdp")),
     (r"to_logits/kernel(_q)?$", P("fsdp", "tp")),
     # CLIP latent projections
     (r"to_(text|visual)_latent/kernel$", P("fsdp", "tp")),

@@ -75,6 +75,40 @@ class MetricsLogger:
         ]
         self._wandb.log({name: imgs}, step=step)
 
+    def log_histogram(self, name: str, values, step: Optional[int] = None):
+        """Full-distribution histogram (the reference's codebook-collapse
+        monitor, train_vae.py:252-262 logs wandb.Histogram(codes)); console
+        falls back to a compact quantile summary."""
+        if not self.enabled:
+            return
+        import numpy as np
+
+        flat = np.asarray(values).reshape(-1)
+        if self._wandb is not None:
+            self._wandb.log({name: self._wandb.Histogram(flat)}, step=step)
+        qs = np.percentile(flat, [0, 25, 50, 75, 100])
+        self.log_text(
+            f"step {step}: {name} histogram n={flat.size} "
+            f"min/q25/med/q75/max={'/'.join(f'{q:g}' for q in qs)} "
+            f"unique={np.unique(flat).size}"
+        )
+
+    def log_artifact(
+        self,
+        name: str,
+        path: str,
+        type: str = "model",
+        metadata: Optional[dict] = None,
+    ):
+        """Upload a file as a wandb artifact (the reference's per-epoch
+        checkpoint upload, train_dalle.py:637-649 / train_vae.py:298-313);
+        no-op without an active wandb run."""
+        if not self.enabled or self._wandb is None:
+            return
+        artifact = self._wandb.Artifact(name, type=type, metadata=metadata or {})
+        artifact.add_file(path)
+        self._wandb.run.log_artifact(artifact)
+
     def finish(self):
         if self._wandb is not None:
             self._wandb.finish()

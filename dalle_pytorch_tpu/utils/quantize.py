@@ -4,11 +4,11 @@ Autoregressive decode reads every transformer kernel from HBM once per
 generated token — at the flagship config that is ~0.4 GB/token in bf16 and
 is the dominant cost of single-chip generation (the reference has no
 quantized serving path at all; its sampling re-runs full forwards in fp16
-at best, dalle_pytorch.py:481-493). Converting the Dense kernels to int8
-with per-output-channel symmetric scales halves those bytes; activations,
-embeddings, norms, biases and every non-Dense parameter stay in full
-precision, and the matvecs widen int8 -> bf16 in registers (see
-ops/layers.py:QuantDense).
+at best, dalle_pytorch.py:481-493). Converting the Dense kernels (per-
+output-channel symmetric scales) and the token-embedding tables (per-row
+scales) to int8 halves those bytes; activations, norms, biases and every
+other parameter stay in full precision, and the matvecs/gathers widen
+int8 -> bf16 in registers (see ops/layers.py:QuantDense / QuantEmbed).
 
 ``quantize_dalle`` maps a trained DALLE + params to its ``serve_quant``
 twin: the target parameter tree comes from ``jax.eval_shape`` on the quant
@@ -38,6 +38,16 @@ def quantize_kernel(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return q, scale
 
 
+def quantize_embedding(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(vocab, dim) float table -> (int8 table, (vocab,) f32 scale),
+    symmetric per-row (each gathered row dequantizes independently)."""
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=1)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale[:, None]), -127, 127).astype(np.int8)
+    return q, scale
+
+
 def _src_path(path: Tuple[str, ...]) -> Tuple[str, ...]:
     """Target (quant) tree path -> source tree path: un-rename the flax
     auto-named QuantDense_i submodules; explicit names are unchanged."""
@@ -59,19 +69,26 @@ def quantize_params(dalle_quant, params, example_text, example_image) -> Dict[st
     out: Dict[Tuple[str, ...], Any] = {}
     quant_cache: Dict[Tuple[str, ...], Tuple[np.ndarray, np.ndarray]] = {}
 
-    def quantized(kernel_path: Tuple[str, ...]):
-        if kernel_path not in quant_cache:
-            quant_cache[kernel_path] = quantize_kernel(np.asarray(flat_s[kernel_path]))
-        return quant_cache[kernel_path]
+    def quantized(src_path: Tuple[str, ...], fn):
+        if src_path not in quant_cache:
+            quant_cache[src_path] = fn(np.asarray(flat_s[src_path]))
+        return quant_cache[src_path]
 
     for path, spec in flat_t.items():
         src = _src_path(path)
         if path[-1] == "kernel_q":
-            q, _ = quantized(src[:-1] + ("kernel",))
+            q, _ = quantized(src[:-1] + ("kernel",), quantize_kernel)
+            assert q.shape == spec.shape, (path, q.shape, spec.shape)
+            out[path] = jnp.asarray(q)
+        elif path[-1] == "embedding_q":
+            q, _ = quantized(src[:-1] + ("embedding",), quantize_embedding)
             assert q.shape == spec.shape, (path, q.shape, spec.shape)
             out[path] = jnp.asarray(q)
         elif path[-1] == "scale" and (path[:-1] + ("kernel_q",)) in flat_t:
-            _, s = quantized(src[:-1] + ("kernel",))
+            _, s = quantized(src[:-1] + ("kernel",), quantize_kernel)
+            out[path] = jnp.asarray(s)
+        elif path[-1] == "scale" and (path[:-1] + ("embedding_q",)) in flat_t:
+            _, s = quantized(src[:-1] + ("embedding",), quantize_embedding)
             out[path] = jnp.asarray(s)
         else:
             leaf = flat_s[src]
@@ -82,9 +99,9 @@ def quantize_params(dalle_quant, params, example_text, example_image) -> Dict[st
 
 def quantize_dalle(dalle, params, batch_size: int = 1):
     """(dalle, trained params) -> (serve_quant dalle, int8 params) ready for
-    ``models/sampling.py`` decode. Only Dense projections are quantized;
-    MoE expert banks and gMLP blocks pass through at full precision
-    (pinned by tests/test_quantize.py)."""
+    ``models/sampling.py`` decode. Dense projections and the token-embedding
+    tables are quantized; MoE expert banks and gMLP blocks pass through at
+    full precision (pinned by tests/test_quantize.py)."""
     dalle_q = dalle.clone(serve_quant=True)
     text = jnp.zeros((batch_size, dalle.text_seq_len), jnp.int32)
     image = jnp.zeros((batch_size, dalle.image_seq_len), jnp.int32)

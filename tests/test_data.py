@@ -205,3 +205,62 @@ class TestTarPipeline:
         b = TarImageTextDataset(spec, text_len=8, image_size=16, process_index=1, process_count=2)
         assert set(a._my_shards()).isdisjoint(b._my_shards())
         assert len(list(a)) == len(list(b)) == 4
+
+
+class TestMetricsLogger:
+    """§5.5 observability additions: histogram + artifact upload (the
+    reference logs wandb.Histogram(codes) in train_vae.py:262 and uploads
+    checkpoint artifacts in train_dalle.py:637-649)."""
+
+    class FakeWandb:
+        def __init__(self):
+            self.logged, self.artifacts = [], []
+            self.run = self
+
+        def Histogram(self, v):
+            return ("hist", np.asarray(v).shape)
+
+        def log(self, d, step=None):
+            self.logged.append((d, step))
+
+        def Artifact(self, name, type="model", metadata=None):
+            class A:
+                def __init__(self):
+                    self.name, self.type, self.metadata = name, type, metadata
+                    self.files = []
+
+                def add_file(self, p):
+                    self.files.append(p)
+
+            return A()
+
+        def log_artifact(self, a):
+            self.artifacts.append(a)
+
+        def finish(self):
+            pass
+
+    def test_histogram_and_artifact_with_wandb(self, tmp_path):
+        from dalle_pytorch_tpu.utils.metrics import MetricsLogger
+
+        logger = MetricsLogger(enabled=True)
+        logger._wandb = self.FakeWandb()
+        logger.log_histogram("codes", np.arange(12).reshape(3, 4), step=7)
+        (d, step), = logger._wandb.logged
+        assert step == 7 and d["codes"] == ("hist", (12,))
+
+        f = tmp_path / "m.ckpt"
+        f.write_bytes(b"x")
+        logger.log_artifact("trained-vae", str(f), metadata={"dim": 8})
+        (a,) = logger._wandb.artifacts
+        assert a.name == "trained-vae" and a.files == [str(f)]
+        assert a.metadata == {"dim": 8}
+
+    def test_noop_without_wandb(self, capsys):
+        from dalle_pytorch_tpu.utils.metrics import MetricsLogger
+
+        logger = MetricsLogger(enabled=True)
+        logger.log_histogram("codes", np.asarray([1, 1, 2, 5]), step=0)
+        logger.log_artifact("x", "/nonexistent/path")  # must not raise
+        out = capsys.readouterr().out
+        assert "histogram" in out and "unique=3" in out

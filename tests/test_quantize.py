@@ -176,3 +176,18 @@ def test_real_tree_ff_kernels_get_megatron_specs(trained):
     for k in down + out:
         assert flat[k] == P("tp", "fsdp"), (k, flat[k])
     assert flat["['to_logits']['kernel']"] == P("fsdp", "tp")
+
+
+def test_embeddings_quantized(trained):
+    dalle, params, text, image = trained
+    dq, pq = quantize_dalle(dalle, params, batch_size=2)
+    for emb in ("text_emb", "image_emb"):
+        assert pq[emb]["embedding_q"].dtype == jnp.int8
+        assert pq[emb]["scale"].shape == (pq[emb]["embedding_q"].shape[0],)
+    # per-row dequant error bounded by half a step
+    src = np.asarray(params["text_emb"]["embedding"], np.float32)
+    deq = np.asarray(pq["text_emb"]["embedding_q"], np.float32) * np.asarray(
+        pq["text_emb"]["scale"]
+    )[:, None]
+    step = np.asarray(pq["text_emb"]["scale"])[:, None]
+    assert (np.abs(deq - src) <= step / 2 + 1e-7).all()

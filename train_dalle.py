@@ -487,6 +487,9 @@ def main():
 
         save(epoch)
         save_sharded(global_step)
+        # per-epoch model artifact (reference train_dalle.py:637-649); the
+        # logger is already root-gated via enabled=
+        logger.log_artifact("trained-dalle", ckpt_path, metadata=vars(args))
         logger.log_text(f"epoch {epoch} complete")
 
     if tracing:  # training ended inside the trace window

@@ -157,9 +157,12 @@ def main():
                 loss_v = float(loss)
                 logs = {"loss": loss_v, "lr": lr, "temp": temp, "epoch": epoch}
 
-                # codebook usage (collapse monitoring, train_vae.py:252-256)
+                # codebook usage (collapse monitoring, train_vae.py:252-262):
+                # the full index histogram shows the SHAPE of a collapse,
+                # the unique count its headline number
                 idx = np.asarray(encode_fn(state.params, batch["image"]))
                 logs["codebook_used"] = int(np.unique(idx).size)
+                logger.log_histogram("codebook_indices", idx, step=global_step)
 
                 if runtime.is_root_worker():
                     from dalle_pytorch_tpu.models.vae import denormalize
@@ -198,6 +201,9 @@ def main():
                 extra={"epoch": epoch, "scheduler_state": sched.state_dict()},
             )
             logger.log_text(f"epoch {epoch} done; saved {args.output_file_name}")
+        # per-epoch model artifact (reference train_vae.py:298-313); the
+        # logger is root-gated via enabled=
+        logger.log_artifact("trained-vae", args.output_file_name, metadata=vars(args))
 
     logger.finish()
 
