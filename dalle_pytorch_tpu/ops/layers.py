@@ -127,7 +127,6 @@ class QuantEmbed(nn.Module):
     num_embeddings: int
     features: int
     dtype: Dtype = jnp.bfloat16
-    param_dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, ids):
@@ -156,12 +155,11 @@ def serving_embed(
     param_dtype: Dtype = jnp.float32,
 ) -> nn.Module:
     """``nn.Embed`` vs int8 ``QuantEmbed`` — the embedding analog of
-    ``serving_dense`` (same structural-parallelism contract)."""
+    ``serving_dense`` (same structural-parallelism contract). param_dtype
+    governs only the trainable table; the int8 twin's dtypes are fixed
+    (int8 rows, f32 scales)."""
     if quant:
-        return QuantEmbed(
-            num_embeddings, features, name=name,
-            dtype=dtype, param_dtype=param_dtype,
-        )
+        return QuantEmbed(num_embeddings, features, name=name, dtype=dtype)
     return nn.Embed(num_embeddings, features, name=name, param_dtype=param_dtype)
 
 

@@ -42,5 +42,8 @@ def test_wheel_ships_vocab_and_native_sources(tmp_path):
         "dalle_pytorch_tpu/data/bpe_simple_vocab_16e6.txt",
         "dalle_pytorch_tpu/native/bpe_tokenizer.cc",
         "dalle_pytorch_tpu/native/unicode_tables.h",
+        "dalle_pytorch_tpu/models/ckpt_manifests/openai_dvae_encoder.json",
+        "dalle_pytorch_tpu/models/ckpt_manifests/openai_dvae_decoder.json",
+        "dalle_pytorch_tpu/models/ckpt_manifests/vqgan_f16_1024.json",
     ):
         assert need in names, f"wheel is missing {need}"

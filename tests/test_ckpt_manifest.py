@@ -1,0 +1,251 @@
+"""Pretrained-checkpoint manifest contract (VERDICT r3 ask #1).
+
+The vendored JSONs under dalle_pytorch_tpu/models/ckpt_manifests/ freeze the
+key/shape inventory of the published checkpoints the reference's default
+``train_dalle.py`` path consumes: OpenAI's dVAE encoder.pkl / decoder.pkl
+(reference vae.py:29-30) and taming's f=16/1024 last.ckpt + model.yaml
+(reference vae.py:150-174). They are derived from the public architectures
+by tools/gen_ckpt_manifests.py — independently of this package's flax
+modules — so these tests genuinely cross-check the converters:
+
+- every manifest key must be consumed by the converter (none skipped),
+- the converted tree must cover the flax module's parameter tree exactly
+  (same paths, same post-transpose shapes, nothing missing, nothing extra).
+
+A converter that silently drops or mis-maps any key in the real layout now
+fails HERE instead of at a user's first real-checkpoint load. The env-gated
+golden test at the bottom additionally runs the real published weights
+end-to-end when DALLE_TPU_REAL_CKPTS points at them.
+"""
+
+import importlib.resources
+import json
+import os
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.models.pretrained import (
+    OpenAIDiscreteVAE,
+    OpenAIEncoder,
+    OpenAIDecoder,
+    convert_openai_decoder,
+    convert_openai_encoder,
+)
+from dalle_pytorch_tpu.models.vqgan import VQGanVAE, convert_vqgan_checkpoint
+
+# the manifests are package data (shipped in the wheel), so an installed
+# copy is the source of truth — these tests work against a wheel install
+# exactly as against the repo tree
+MANIFEST_DIR = importlib.resources.files("dalle_pytorch_tpu.models") / "ckpt_manifests"
+
+
+def load_manifest(name):
+    return json.loads((MANIFEST_DIR / name).read_text())
+
+
+def synthetic_sd(manifest):
+    """Deterministic small-valued arrays in the manifest's shapes."""
+    rng = np.random.RandomState(0)
+    return {
+        k: rng.randn(*spec["shape"]).astype(spec["dtype"]) * 0.02
+        for k, spec in manifest.items()
+    }
+
+
+def flat_shapes(tree):
+    out = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        out[jax.tree_util.keystr(path)] = tuple(leaf.shape)
+    return out
+
+
+def test_manifests_match_generator():
+    """The vendored JSONs must stay in sync with the architecture walk in
+    tools/gen_ckpt_manifests.py (regeneration is the provenance record)."""
+    import sys
+
+    tools = Path(__file__).resolve().parent.parent / "tools"
+    if not tools.exists():
+        pytest.skip("generator lives in the repo tree, not the wheel")
+    sys.path.insert(0, str(tools))
+    import gen_ckpt_manifests as gen
+
+    assert load_manifest("openai_dvae_encoder.json") == gen.openai_dvae_manifest("encoder")
+    assert load_manifest("openai_dvae_decoder.json") == gen.openai_dvae_manifest("decoder")
+    vq = load_manifest("vqgan_f16_1024.json")
+    assert vq["state_dict"] == gen.vqgan_manifest()
+    assert vq["config"] == gen.VQGAN_F16_1024_CONFIG
+
+
+@pytest.mark.parametrize("kind", ["encoder", "decoder"])
+def test_openai_converter_consumes_exact_manifest(kind):
+    manifest = load_manifest(f"openai_dvae_{kind}.json")
+    sd = synthetic_sd(manifest)
+    convert = convert_openai_encoder if kind == "encoder" else convert_openai_decoder
+    converted = convert(sd)
+
+    # every manifest key consumed: each (w, b) pair lands as one flax leaf
+    n_leaves = len(jax.tree_util.tree_leaves(converted))
+    assert n_leaves == len(manifest), (
+        f"{len(manifest) - n_leaves} manifest keys were not consumed"
+    )
+
+    if kind == "encoder":
+        module, x = OpenAIEncoder(), jnp.zeros((1, 256, 256, 3))
+    else:
+        module, x = OpenAIDecoder(), jnp.zeros((1, 32, 32, 8192))
+    expected = jax.eval_shape(module.init, jax.random.key(0), x)["params"]
+
+    got, want = flat_shapes(converted), flat_shapes(expected)
+    assert got == want, (
+        f"converted tree != flax tree\nmissing: {sorted(set(want) - set(got))[:10]}"
+        f"\nextra: {sorted(set(got) - set(want))[:10]}"
+        f"\nshape-diff: {[k for k in got.keys() & want.keys() if got[k] != want[k]][:10]}"
+    )
+
+
+def test_openai_wrapper_runs_on_manifest_weights():
+    """The full OpenAIDiscreteVAE surface must run on a manifest-shaped
+    checkpoint (the exact code path load_openai_vae takes)."""
+    params = {
+        "enc": convert_openai_encoder(synthetic_sd(load_manifest("openai_dvae_encoder.json"))),
+        "dec": convert_openai_decoder(synthetic_sd(load_manifest("openai_dvae_decoder.json"))),
+    }
+    vae = OpenAIDiscreteVAE()
+    img = jnp.zeros((1, 64, 64, 3))  # any multiple of 8 works for the graph
+    idx = vae.apply({"params": params}, img, method="get_codebook_indices")
+    assert idx.shape == (1, 64)
+    out = vae.apply({"params": params}, idx, method="decode")
+    assert out.shape == (1, 64, 64, 3)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_vqgan_converter_consumes_exact_manifest():
+    m = load_manifest("vqgan_f16_1024.json")
+    sd = synthetic_sd(m["state_dict"])
+    # the real last.ckpt carries LPIPS/discriminator weights under loss.*
+    # (and GumbelVQ ckpts a temperature scheduler) — the converter must skip
+    # them without error
+    sd["loss.discriminator.main.0.weight"] = np.zeros((64, 3, 4, 4), np.float32)
+    sd["loss.perceptual_loss.lin0.model.1.weight"] = np.zeros((1, 64, 1, 1), np.float32)
+    converted = convert_vqgan_checkpoint(sd)
+
+    n_leaves = len(jax.tree_util.tree_leaves(converted))
+    assert n_leaves == len(m["state_dict"]), (
+        f"{len(m['state_dict']) - n_leaves} model keys were not consumed"
+    )
+
+    cfg, dd = m["config"], m["config"]["ddconfig"]
+    vae = VQGanVAE(
+        image_size=dd["resolution"], ch=dd["ch"], ch_mult=tuple(dd["ch_mult"]),
+        num_res_blocks=dd["num_res_blocks"],
+        attn_resolutions=tuple(dd["attn_resolutions"]),
+        z_channels=dd["z_channels"], n_embed=cfg["n_embed"],
+        embed_dim=cfg["embed_dim"],
+    )
+    img = jnp.zeros((1, dd["resolution"], dd["resolution"], 3))
+    seq = jnp.zeros((1, vae.image_seq_len), jnp.int32)
+    enc_params = jax.eval_shape(
+        lambda k: vae.init(k, img, method="get_codebook_indices"), jax.random.key(0)
+    )["params"]
+    dec_params = jax.eval_shape(
+        lambda k: vae.init(k, seq, method="decode"), jax.random.key(0)
+    )["params"]
+    # merge the two entry points' param trees (they overlap on quantize)
+    merged = dict(dec_params)
+    for k, v in enc_params.items():
+        merged[k] = v
+
+    got, want = flat_shapes(converted), flat_shapes(merged)
+    assert got == want, (
+        f"converted tree != flax tree\nmissing: {sorted(set(want) - set(got))[:10]}"
+        f"\nextra: {sorted(set(got) - set(want))[:10]}"
+        f"\nshape-diff: {[k for k in got.keys() & want.keys() if got[k] != want[k]][:10]}"
+    )
+
+
+def test_vqgan_wrapper_runs_on_manifest_weights():
+    m = load_manifest("vqgan_f16_1024.json")
+    converted = convert_vqgan_checkpoint(synthetic_sd(m["state_dict"]))
+    vae = VQGanVAE()  # defaults ARE the f16/1024 published config
+    img = jnp.zeros((1, 32, 32, 3))  # graph is resolution-agnostic
+    idx = vae.apply({"params": converted}, img, method="get_codebook_indices")
+    assert idx.shape == (1, 4)
+    out = vae.apply({"params": converted}, idx, method="decode")
+    assert out.shape == (1, 32, 32, 3)
+    assert bool(jnp.isfinite(out).all())
+
+
+# ------------------------------------------------------------ real weights
+
+REAL = os.environ.get("DALLE_TPU_REAL_CKPTS")
+
+
+@pytest.mark.skipif(
+    not REAL, reason="set DALLE_TPU_REAL_CKPTS=<dir with encoder.pkl/"
+    "decoder.pkl[/last.ckpt]> to run the published-weight golden test"
+)
+def test_real_openai_checkpoints_golden():
+    from dalle_pytorch_tpu.models.pretrained import (
+        load_openai_vae,
+        load_torch_checkpoint,
+    )
+
+    real = Path(REAL)
+    # 1. inventory must equal the vendored manifest exactly
+    for fname, mname in (
+        ("encoder.pkl", "openai_dvae_encoder.json"),
+        ("decoder.pkl", "openai_dvae_decoder.json"),
+    ):
+        sd = load_torch_checkpoint(str(real / fname))
+        manifest = load_manifest(mname)
+        assert {k: list(v.shape) for k, v in sd.items()} == {
+            k: v["shape"] for k, v in manifest.items()
+        }, f"{fname} inventory drifted from the vendored manifest"
+
+    # 2. golden roundtrip: a smooth synthetic fixture must reconstruct
+    vae, params = load_openai_vae(
+        enc_path=str(real / "encoder.pkl"), dec_path=str(real / "decoder.pkl")
+    )
+    yy, xx = np.mgrid[:256, :256] / 255.0
+    img = np.stack([yy, xx, 0.5 * (yy + xx)], -1)[None].astype(np.float32)
+    idx = vae.apply({"params": params}, jnp.asarray(img), method="get_codebook_indices")
+    assert idx.shape == (1, 1024)
+    # token histogram sanity: a smooth gradient uses many distinct codes
+    assert np.unique(np.asarray(idx)).size > 16
+    recon = vae.apply({"params": params}, idx, method="decode")
+    err = float(jnp.abs(recon - img).mean())
+    assert err < 0.1, f"reconstruction error {err:.3f} too high for real weights"
+
+
+@pytest.mark.skipif(
+    not REAL or not (Path(REAL or ".") / "last.ckpt").exists(),
+    reason="needs DALLE_TPU_REAL_CKPTS with taming last.ckpt + model.yaml",
+)
+def test_real_vqgan_checkpoint_golden():
+    from dalle_pytorch_tpu.models.pretrained import load_torch_checkpoint
+    from dalle_pytorch_tpu.models.vqgan import load_vqgan_vae
+
+    real = Path(REAL)
+    sd = load_torch_checkpoint(str(real / "last.ckpt"))
+    manifest = load_manifest("vqgan_f16_1024.json")["state_dict"]
+    model_keys = {k: list(v.shape) for k, v in sd.items() if not k.startswith("loss.")}
+    assert model_keys == {k: v["shape"] for k, v in manifest.items()}, (
+        "last.ckpt inventory drifted from the vendored manifest"
+    )
+
+    vae, params = load_vqgan_vae(
+        config_path=str(real / "model.yaml"), model_path=str(real / "last.ckpt")
+    )
+    yy, xx = np.mgrid[:256, :256] / 255.0
+    img = np.stack([yy, xx, 0.5 * (yy + xx)], -1)[None].astype(np.float32)
+    idx = vae.apply({"params": params}, jnp.asarray(img), method="get_codebook_indices")
+    assert idx.shape == (1, 256)
+    assert np.unique(np.asarray(idx)).size > 8
+    recon = vae.apply({"params": params}, idx, method="decode")
+    err = float(jnp.abs(recon - img).mean())
+    assert err < 0.15, f"reconstruction error {err:.3f} too high for real weights"

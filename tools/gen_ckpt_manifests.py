@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""Generate the vendored pretrained-checkpoint manifests.
+
+A manifest is the frozen key -> (shape, dtype) inventory of a published
+checkpoint the reference's default ``train_dalle.py`` path consumes:
+
+- OpenAI dVAE ``encoder.pkl`` / ``decoder.pkl``
+  (reference vae.py:29-30,107-108; architecture from the public
+  github.com/openai/DALL-E ``encoder.py``/``decoder.py``), and
+- taming-transformers VQGAN imagenet f=16 / 1024-codebook ``last.ckpt`` +
+  ``model.yaml`` (reference vae.py:150-174; architecture from the public
+  CompVis/taming-transformers ``model.py``/``vqgan.py`` driven by the
+  published ddconfig).
+
+Two modes:
+
+- default: derive the inventory from the architecture itself — the channel
+  arithmetic below is written out in torch conventions (OIHW convs,
+  ``weight``/``bias`` leaves) INDEPENDENTLY of this package's flax modules,
+  so the manifest tests in tests/test_ckpt_manifest.py genuinely cross-check
+  the converters rather than comparing the converters to themselves;
+- ``--from-real DIR``: regenerate from the actual downloaded files
+  (DIR/encoder.pkl, DIR/decoder.pkl, DIR/last.ckpt) and fail LOUDLY if the
+  result differs from the architecture-derived manifest. Run this whenever
+  the published files are available to re-certify the vendored JSONs.
+
+Output: dalle_pytorch_tpu/models/ckpt_manifests/*.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+OUT_DIR = (
+    Path(__file__).resolve().parent.parent
+    / "dalle_pytorch_tpu" / "models" / "ckpt_manifests"
+)
+
+
+def _conv(keys: dict, name: str, cin: int, cout: int, k: int, leaf_w="w", leaf_b="b"):
+    keys[f"{name}.{leaf_w}"] = {"shape": [cout, cin, k, k], "dtype": "float32"}
+    keys[f"{name}.{leaf_b}"] = {"shape": [cout], "dtype": "float32"}
+
+
+def openai_dvae_manifest(kind: str) -> dict:
+    """OpenAI dVAE module state dict. Encoder: 7x7 input conv, 4 groups x 2
+    bottleneck blocks (res path 3,3,3,1 kernels; 1x1 id_path on channel
+    change), maxpool between groups, relu + 1x1 conv to 8192 logits.
+    Decoder mirrors it: 1x1 input conv from the one-hot, res path 1,3,3,3
+    kernels, nearest-2x upsample between groups, 1x1 conv to 2*3 stats."""
+    n_hid, vocab, n_blk = 256, 8192, 2
+    keys: dict = {}
+    if kind == "encoder":
+        _conv(keys, "blocks.input", 3, n_hid, 7)
+        cin = n_hid
+        for g, mult in enumerate((1, 2, 4, 8), start=1):
+            cout = mult * n_hid
+            for b in range(1, n_blk + 1):
+                p = f"blocks.group_{g}.block_{b}"
+                if cin != cout:
+                    _conv(keys, f"{p}.id_path", cin, cout, 1)
+                hid = cout // 4
+                _conv(keys, f"{p}.res_path.conv_1", cin, hid, 3)
+                _conv(keys, f"{p}.res_path.conv_2", hid, hid, 3)
+                _conv(keys, f"{p}.res_path.conv_3", hid, hid, 3)
+                _conv(keys, f"{p}.res_path.conv_4", hid, cout, 1)
+                cin = cout
+        _conv(keys, "blocks.output.conv", 8 * n_hid, vocab, 1)
+    else:
+        n_init = 128
+        _conv(keys, "blocks.input", vocab, n_init, 1)
+        cin = n_init
+        for g, mult in enumerate((8, 4, 2, 1), start=1):
+            cout = mult * n_hid
+            for b in range(1, n_blk + 1):
+                p = f"blocks.group_{g}.block_{b}"
+                if cin != cout:
+                    _conv(keys, f"{p}.id_path", cin, cout, 1)
+                hid = cout // 4
+                _conv(keys, f"{p}.res_path.conv_1", cin, hid, 1)
+                _conv(keys, f"{p}.res_path.conv_2", hid, hid, 3)
+                _conv(keys, f"{p}.res_path.conv_3", hid, hid, 3)
+                _conv(keys, f"{p}.res_path.conv_4", hid, cout, 3)
+                cin = cout
+        _conv(keys, "blocks.output.conv", n_hid, 2 * 3, 1)
+    return keys
+
+
+# the published imagenet f=16 / 1024 model.yaml (reference vae.py:155-158)
+VQGAN_F16_1024_CONFIG = {
+    "target": "taming.models.vqgan.VQModel",
+    "n_embed": 1024,
+    "embed_dim": 256,
+    "ddconfig": {
+        "double_z": False,
+        "z_channels": 256,
+        "resolution": 256,
+        "in_channels": 3,
+        "out_ch": 3,
+        "ch": 128,
+        "ch_mult": [1, 1, 2, 2, 4],
+        "num_res_blocks": 2,
+        "attn_resolutions": [16],
+        "dropout": 0.0,
+    },
+}
+
+
+def vqgan_manifest(cfg: dict = VQGAN_F16_1024_CONFIG) -> dict:
+    """taming VQModel ``state_dict`` (model keys only — the published
+    last.ckpt also carries ``loss.*`` LPIPS/discriminator weights the
+    inference wrapper skips). Norms are GroupNorm(32) with 1-d
+    weight/bias; convs are 3x3 pad-1 except the marked 1x1s."""
+    dd = cfg["ddconfig"]
+    ch, ch_mult = dd["ch"], list(dd["ch_mult"])
+    nrb, attn_res = dd["num_res_blocks"], set(dd["attn_resolutions"])
+    z, res = dd["z_channels"], dd["resolution"]
+    keys: dict = {}
+
+    def norm(name, c):
+        keys[f"{name}.weight"] = {"shape": [c], "dtype": "float32"}
+        keys[f"{name}.bias"] = {"shape": [c], "dtype": "float32"}
+
+    def conv(name, cin, cout, k):
+        _conv(keys, name, cin, cout, k, leaf_w="weight", leaf_b="bias")
+
+    def resnet(prefix, cin, cout):
+        norm(f"{prefix}.norm1", cin)
+        conv(f"{prefix}.conv1", cin, cout, 3)
+        norm(f"{prefix}.norm2", cout)
+        conv(f"{prefix}.conv2", cout, cout, 3)
+        if cin != cout:
+            conv(f"{prefix}.nin_shortcut", cin, cout, 1)
+        return cout
+
+    def attn(prefix, c):
+        norm(f"{prefix}.norm", c)
+        for p in ("q", "k", "v", "proj_out"):
+            conv(f"{prefix}.{p}", c, c, 1)
+
+    # ----- encoder
+    conv("encoder.conv_in", dd["in_channels"], ch, 3)
+    cur, cur_res = ch, res
+    for i, mult in enumerate(ch_mult):
+        cout = ch * mult
+        for j in range(nrb):
+            cur = resnet(f"encoder.down.{i}.block.{j}", cur, cout)
+            if cur_res in attn_res:
+                attn(f"encoder.down.{i}.attn.{j}", cout)
+        if i != len(ch_mult) - 1:
+            conv(f"encoder.down.{i}.downsample.conv", cout, cout, 3)
+            cur_res //= 2
+    norm("encoder.norm_out", cur)
+    conv("encoder.conv_out", cur, (2 if dd["double_z"] else 1) * z, 3)
+
+    # ----- decoder
+    block_in = ch * ch_mult[-1]
+    cur_res = res // 2 ** (len(ch_mult) - 1)
+    conv("decoder.conv_in", z, block_in, 3)
+    cur = block_in
+    cur = resnet("decoder.mid.block_1", cur, cur)
+    attn("decoder.mid.attn_1", cur)
+    cur = resnet("decoder.mid.block_2", cur, cur)
+    for i in reversed(range(len(ch_mult))):
+        cout = ch * ch_mult[i]
+        for j in range(nrb + 1):
+            cur = resnet(f"decoder.up.{i}.block.{j}", cur, cout)
+            if cur_res in attn_res:
+                attn(f"decoder.up.{i}.attn.{j}", cout)
+        if i != 0:
+            conv(f"decoder.up.{i}.upsample.conv", cout, cout, 3)
+            cur_res *= 2
+    norm("decoder.norm_out", cur)
+    conv("decoder.conv_out", cur, dd["out_ch"], 3)
+
+    # ----- encoder mid (appended here to keep the walk readable above)
+    block_in = ch * ch_mult[-1]
+    resnet("encoder.mid.block_1", block_in, block_in)
+    attn("encoder.mid.attn_1", block_in)
+    resnet("encoder.mid.block_2", block_in, block_in)
+
+    # ----- quantizer + couplers
+    keys["quantize.embedding.weight"] = {
+        "shape": [cfg["n_embed"], cfg["embed_dim"]], "dtype": "float32"
+    }
+    conv("quant_conv", z, cfg["embed_dim"], 1)
+    conv("post_quant_conv", cfg["embed_dim"], z, 1)
+    return keys
+
+
+def write_manifests():
+    OUT_DIR.mkdir(parents=True, exist_ok=True)
+    out = {
+        "openai_dvae_encoder.json": openai_dvae_manifest("encoder"),
+        "openai_dvae_decoder.json": openai_dvae_manifest("decoder"),
+        "vqgan_f16_1024.json": {
+            "config": VQGAN_F16_1024_CONFIG,
+            "state_dict": vqgan_manifest(),
+        },
+    }
+    for name, data in out.items():
+        path = OUT_DIR / name
+        path.write_text(json.dumps(data, indent=1, sort_keys=True) + "\n")
+        n = len(data.get("state_dict", data))
+        print(f"wrote {path} ({n} keys)")
+
+
+def check_against_real(real_dir: str):
+    """Regenerate from the real files and diff against the derived manifest
+    (run wherever the published checkpoints are available)."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.models.pretrained import load_torch_checkpoint
+
+    def inventory(sd):
+        return {
+            k: {"shape": list(np.asarray(v).shape), "dtype": str(np.asarray(v).dtype)}
+            for k, v in sd.items()
+        }
+
+    real = Path(real_dir)
+    problems = []
+    for fname, derived in (
+        ("encoder.pkl", openai_dvae_manifest("encoder")),
+        ("decoder.pkl", openai_dvae_manifest("decoder")),
+    ):
+        actual = inventory(load_torch_checkpoint(str(real / fname)))
+        if {k: v["shape"] for k, v in actual.items()} != {
+            k: v["shape"] for k, v in derived.items()
+        }:
+            problems.append((fname, set(actual) ^ set(derived)))
+    ckpt = real / "last.ckpt"
+    if ckpt.exists():
+        actual = {
+            k: v for k, v in inventory(load_torch_checkpoint(str(ckpt))).items()
+            if not k.startswith("loss.")
+        }
+        derived = vqgan_manifest()
+        if {k: v["shape"] for k, v in actual.items()} != {
+            k: v["shape"] for k, v in derived.items()
+        }:
+            problems.append(("last.ckpt", set(actual) ^ set(derived)))
+    if problems:
+        for fname, diff in problems:
+            print(f"MISMATCH {fname}: {sorted(diff)[:20]}")
+        raise SystemExit(1)
+    print("real checkpoints match the derived manifests")
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--from-real", default=None, metavar="DIR",
+                    help="directory holding encoder.pkl / decoder.pkl / "
+                         "last.ckpt to re-certify the manifests against")
+    args = ap.parse_args()
+    if args.from_real:
+        check_against_real(args.from_real)
+    write_manifests()
