@@ -62,14 +62,21 @@ def model_flops_per_step(batch: int, depth: int = DEPTH) -> float:
     return 3 * fwd
 
 
-def device_flops_per_step(batch: int, depth: int = DEPTH) -> float:
+def device_flops_per_step(batch: int, depth: int = DEPTH, rotary: bool = True) -> float:
     """FLOPs the hardware actually executes per step — the cross-check
     target for XLA cost analysis. Differs from the MFU convention in the
     attention kernels: the recompute-based flash backward re-derives the
     score matrix in both the dq and dk/dv passes (4 + 6 block dots vs the
-    convention's 4), and partially-masked blocks execute full-square."""
+    convention's 4), and partially-masked blocks execute full-square.
+    ``rotary`` mirrors the benchmarked model's rotary_emb flag: the
+    in-kernel rotate-half P-dots only execute when the fused path receives
+    a rotary table (counting them unconditionally overstated device FLOPs
+    ~6% for a no-rotary config)."""
     from dalle_pytorch_tpu.ops.attention import _flash_block
-    from dalle_pytorch_tpu.ops.flash_attention import _block_visit_map
+    from dalle_pytorch_tpu.ops.flash_attention import (
+        _block_visit_map,
+        fused_qkv_supported,
+    )
 
     n = TEXT_SEQ + IMAGE_FMAP**2
     per_layer_params = 16 * DIM * DIM
@@ -83,14 +90,15 @@ def device_flops_per_step(batch: int, depth: int = DEPTH) -> float:
     dense += 3 * 2 * batch * DIM * (TEXT_SEQ * ext + IMAGE_FMAP**2 * NUM_IMAGE)
 
     block = _flash_block(n)
-    if block == n:
+    if block == n and fused_qkv_supported(n, HEADS, DIM_HEAD):
         # packed single-block path: fwd 2 dots + ONE fused backward pass of
-        # 5 dots (s, dp, dq, dv, dk) = 7 per head, plus the in-kernel
-        # rotate-half P-dots (3 fwd + 6 bwd per head: q/k/v rotation in both
-        # passes and the inverse rotation of the three grads) — matches
-        # _fused_cost in ops/flash_attention.py
+        # 5 dots (s, dp, dq, dv, dk) = 7 per head, plus (when the model has
+        # rotary) the in-kernel rotate-half P-dots (3 fwd + 6 bwd per head:
+        # q/k/v rotation in both passes and the inverse rotation of the
+        # three grads) — matches _fused_cost in ops/flash_attention.py
         attn = depth * batch * HEADS * 7 * 2 * n * n * DIM_HEAD
-        attn += depth * batch * HEADS * 9 * 2 * n * DIM_HEAD * DIM_HEAD
+        if rotary:
+            attn += depth * batch * HEADS * 9 * 2 * n * DIM_HEAD * DIM_HEAD
     elif block:
         visit = _block_visit_map(n // block, n // block, block, block, True, None)
         live = int((visit > 0).sum())
@@ -162,7 +170,7 @@ def bench_train(on_cpu: bool):
     lowered = step.lower(state, batch_data, jax.random.key(0))
     compiled = lowered.compile()
     analytic = model_flops_per_step(batch, depth)
-    device_analytic = device_flops_per_step(batch, depth)
+    device_analytic = device_flops_per_step(batch, depth, rotary=dalle.rotary_emb)
     xla_flops = compiled_flops(compiled, device_analytic)
 
     # warmup / compile; float() forces a real device->host sync (some
@@ -278,8 +286,56 @@ def _retry(fn, attempts: int = 3):
             time.sleep(5)
 
 
+def bench_breakdown(on_cpu: bool):
+    """--breakdown: per-module FLOPs table from the compiled HLO (the analog
+    of the reference's DeepSpeed flops-profiler module table,
+    /root/reference/train_dalle.py:473-480). Dots/convs are charged from
+    their compiled shapes; the pallas attention custom-calls from the same
+    analytic estimate their CostEstimates feed XLA."""
+    from dalle_pytorch_tpu.utils.hlo_breakdown import format_table, parse_hlo_flops
+
+    batch = 2 if on_cpu else BATCH
+    depth = 2 if on_cpu else DEPTH
+    dalle, state, step, batch_data = build(batch, depth)
+    compiled = step.lower(state, batch_data, jax.random.key(0)).compile()
+
+    n = TEXT_SEQ + IMAGE_FMAP**2
+    # per-custom-call analytic FLOPs (fused packed-qkv kernel: fwd 2 block
+    # dots + 3 rotary P-dots per head; bwd 5 + 6 — see device_flops_per_step)
+    fwd_cc = batch * HEADS * (2 * 2 * n * n * DIM_HEAD + 3 * 2 * n * DIM_HEAD * DIM_HEAD)
+    bwd_cc = batch * HEADS * (5 * 2 * n * n * DIM_HEAD + 6 * 2 * n * DIM_HEAD * DIM_HEAD)
+
+    def cc_flops(line: str):
+        # pallas kernels lose op_name metadata in compiled HLO; classify by
+        # structure — the fused fwd returns (bf16 out, f32 lse), the
+        # single-pass bwd returns the (dq, dk, dv) triple
+        if 'custom_call_target="tpu_custom_call"' not in line:
+            return None
+        head = line.split("custom-call(", 1)[0]
+        kind = "fwd" if "f32[" in head else "bwd"  # fwd returns the f32 lse
+        return ("transformer/attn[pallas]", kind, fwd_cc if kind == "fwd" else bwd_cc)
+
+    groups = parse_hlo_flops(compiled.as_text(), custom_call_flops=cc_flops)
+
+    # measured step time for the proportional-time column
+    for i in range(2):
+        state, loss = step(state, batch_data, jax.random.key(i))
+    float(loss)
+    t0 = time.perf_counter()
+    n_steps = 2 if on_cpu else 10
+    for i in range(n_steps):
+        state, loss = step(state, batch_data, jax.random.key(i))
+    float(loss)
+    step_time = (time.perf_counter() - t0) / n_steps
+
+    print(format_table(groups, step_time_s=step_time, peak_flops=peak_flops()))
+
+
 def main():
     on_cpu = jax.devices()[0].platform == "cpu"
+    if "--breakdown" in sys.argv:
+        _retry(lambda: bench_breakdown(on_cpu))
+        return
     gen = _retry(lambda: bench_generation(on_cpu))
     gen_int8 = _retry(lambda: bench_generation(on_cpu, int8=True))
     train = _retry(lambda: bench_train(on_cpu))

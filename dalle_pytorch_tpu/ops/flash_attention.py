@@ -736,12 +736,23 @@ def _rot_tables(rot, n, d, dtype):
     zero-padded to the head dim (zero angle = identity rotation), and the
     angles are cast to the compute dtype BEFORE cos/sin — exactly matching
     apply_rotary_emb's `angle_table.astype(t.dtype)` (ops/rotary.py:82) so
-    the fused path is bit-compatible with the unfused one at f32."""
+    the fused path is bit-compatible with the unfused one at f32.
+
+    The table must be PAIR-CONSTANT (angle identical within each (2i, 2i+1)
+    channel pair): the fused backward's inverse rotation computes
+    (dy @ P) * sin, which equals the true VJP term (sin * dy) @ P^T only
+    under that symmetry. Every table rotary.py produces satisfies it (the
+    repeat-2 in `angles`); a foreign table that does not would produce a
+    correct forward with silently wrong gradients, so it is rejected here."""
     table = rot.table
     assert table.shape[0] >= n, (table.shape, n)
     table = table[:n]
     if table.shape[1] < d:
         table = np.pad(table, ((0, 0), (0, d - table.shape[1])))
+    assert np.array_equal(table[:, 0::2], table[:, 1::2]), (
+        "fused rotary requires a pair-constant angle table "
+        "(table[:, 0::2] == table[:, 1::2]); see ops/rotary.py:angles"
+    )
     ang = jnp.asarray(table).astype(dtype)
     return jnp.cos(ang), jnp.sin(ang)
 
@@ -845,6 +856,12 @@ def _fused_qkv_bwd_kernel(
     dv_ref[0] = dvs[0] if hpb == 1 else jnp.concatenate(dvs, axis=-1)
 
 
+# one budget, two consumers: _call_plain hands it to Mosaic, and
+# fused_qkv_supported derives the admissible n from it — keep in sync by
+# construction
+FUSED_VMEM_LIMIT_BYTES = 100 * 1024 * 1024
+
+
 def _call_plain(kernel, grid, in_specs, out_specs, out_shape, operands, interpret, cost):
     return pl.pallas_call(
         kernel,
@@ -857,7 +874,7 @@ def _call_plain(kernel, grid, in_specs, out_specs, out_shape, operands, interpre
             # the head-group backward holds several (n, n) f32 temporaries
             # at once (s, p, dp, ds); the default 16 MiB scoped-vmem budget
             # is exceeded at n=1280 x 2 heads — v5e has 128 MiB physical
-            vmem_limit_bytes=100 * 1024 * 1024,
+            vmem_limit_bytes=FUSED_VMEM_LIMIT_BYTES,
         ),
         cost_estimate=cost,
         interpret=interpret,
@@ -866,12 +883,21 @@ def _call_plain(kernel, grid, in_specs, out_specs, out_shape, operands, interpre
 
 def fused_qkv_supported(n, heads, dim_head):
     """The packed path needs a lane-aligned whole-row block that fits VMEM
-    (the backward holds several (n, n) f32 temporaries at once) and
-    128-aligned head groups."""
+    and 128-aligned head groups. The n bound is derived from the backward's
+    VMEM footprint instead of a fixed cap: per head group it materializes
+    ~4 (n, n) f32 score-sized temporaries (s, p, dp, ds) x hpb unrolled
+    heads, which must fit the 100 MB vmem_limit_bytes set in _call_plain
+    (v5e has 128 MB physical) with ~20% headroom for the qkv/do/o blocks
+    and double-buffered I/O. At d=64 (hpb=2) this admits n <= 1536
+    (75.5 MB; verified to compile and run on v5e) and rejects n = 1792+;
+    a fixed n <= 2048 cap used to pass this check yet fail to compile on
+    real hardware."""
     hpb = max(1, 128 // dim_head)
+    vmem_budget = int(FUSED_VMEM_LIMIT_BYTES * 0.8)
+    bwd_temp_bytes = 4 * n * n * 4 * hpb
     return (
         n % 128 == 0
-        and n <= 2048
+        and bwd_temp_bytes <= vmem_budget
         and (dim_head * hpb) % 128 == 0
         and heads % hpb == 0
         and (heads * dim_head) % 128 == 0

@@ -1,0 +1,168 @@
+"""Per-module FLOPs breakdown from a compiled XLA program.
+
+The analog of the reference's DeepSpeed flops-profiler table
+(/root/reference/train_dalle.py:473-480 prints a module-depth breakdown of
+FLOPs/latency): here the numbers come from the compiled HLO itself — every
+``dot``/``convolution`` op's FLOPs are computed from its shapes and charged
+to the flax module scope recorded in its ``op_name`` metadata (the jax name
+stack, e.g. ``jit(train_step)/jvp(DALLE)/transformer/attn_3/...``), so the
+table reflects what XLA actually compiled, not a hand model. Pallas kernels
+appear as ``custom-call`` ops whose FLOPs XLA cannot see; they are charged
+from the caller-supplied analytic estimate (the same CostEstimates the
+kernels feed XLA's scheduler).
+
+``jvp(...)`` scopes are forward ops, ``transpose(jvp(...))`` backward —
+the table splits the two the way the reference's profiler splits
+fwd/bwd latency.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import defaultdict
+from typing import Callable, Dict, Optional, Tuple
+
+_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*\w+\[([\d,]*)\]")
+_OPND_RE = re.compile(r"%([\w.\-]+)")
+_META_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def _shape_of(defs: Dict[str, Tuple[int, ...]], name: str) -> Tuple[int, ...]:
+    return defs.get(name, ())
+
+
+def _prod(xs) -> int:
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def parse_hlo_flops(
+    hlo_text: str,
+    custom_call_flops: Optional[Callable[[str], float]] = None,
+) -> Dict[str, Dict[str, float]]:
+    """HLO text -> {module_scope: {"fwd": flops, "bwd": flops}}.
+
+    module_scope is the op_name path with the jit/jvp wrappers stripped,
+    truncated to the first two user components (e.g. ``transformer/attn_3``,
+    ``to_logits``). ``custom_call_flops(line)`` supplies accounting for
+    opaque custom-calls — pallas kernels carry no op_name metadata in the
+    compiled HLO, so the callback receives the whole line and returns
+    (scope, "fwd" | "bwd", flops) or None to skip.
+    """
+    defs: Dict[str, Tuple[int, ...]] = {}
+    for line in hlo_text.splitlines():
+        m = _DEF_RE.match(line)
+        if m:
+            name, dims = m.groups()
+            defs[name] = tuple(int(d) for d in dims.split(",")) if dims else ()
+
+    out: Dict[str, Dict[str, float]] = defaultdict(lambda: {"fwd": 0.0, "bwd": 0.0})
+
+    for line in hlo_text.splitlines():
+        line = line.strip()
+        meta = _META_RE.search(line)
+        op_name = meta.group(1) if meta else ""
+        flops = 0.0
+
+        if " dot(" in line or line.startswith("dot("):
+            m = _DEF_RE.match(line)
+            if not m:
+                continue
+            out_shape = defs[m.group(1)]
+            # operands appear right after "dot("
+            args = _OPND_RE.findall(line.split(" dot(", 1)[1])
+            lhs_shape = _shape_of(defs, args[0]) if args else ()
+            cdims = re.search(r"lhs_contracting_dims=\{([\d,]*)\}", line)
+            contracted = _prod(
+                lhs_shape[int(i)] for i in cdims.group(1).split(",") if i
+            ) if (cdims and lhs_shape) else 1
+            flops = 2.0 * _prod(out_shape) * contracted
+        elif " convolution(" in line:
+            m = _DEF_RE.match(line)
+            if not m:
+                continue
+            out_shape = defs[m.group(1)]
+            args = _OPND_RE.findall(line.split(" convolution(", 1)[1])
+            rhs_shape = _shape_of(defs, args[1]) if len(args) > 1 else ()
+            dnums = re.search(r"dim_labels=([\w.]+)_([\w.]+)->", line)
+            if rhs_shape and dnums:
+                rhs_labels = dnums.group(2)
+                # rhs output-feature dim is labeled 'o' (kernel iOhw forms)
+                o_idx = rhs_labels.index("o" if "o" in rhs_labels else "f")
+                per_out = _prod(rhs_shape) // max(int(rhs_shape[o_idx]), 1)
+                flops = 2.0 * _prod(out_shape) * per_out
+        elif "custom-call" in line and custom_call_flops is not None:
+            acc = custom_call_flops(line)
+            if acc:
+                scope, kind, cc_flops = acc
+                out[scope][kind] += float(cc_flops)
+            continue
+        if flops <= 0:
+            continue
+
+        is_bwd = "transpose(" in op_name
+        scope = scope_of(op_name)
+        out[scope]["bwd" if is_bwd else "fwd"] += flops
+    return dict(out)
+
+
+def scope_of(op_name: str) -> str:
+    """op_name metadata -> short module scope: strip jit/jvp/transpose/
+    named wrappers and keep the first two model components."""
+    parts = [
+        p for p in op_name.split("/")
+        if p and not re.match(r"^(jit|jvp|transpose|vmap|while|body|cond|remat|checkpoint|custom[-_]vjp|named)\b", p)
+        and not p.startswith("broadcast_in_dim")
+    ]
+    # drop flax's anonymous fn wrappers and trailing primitive names
+    parts = [p for p in parts if p not in ("fn", "model")]
+    if not parts:
+        return "(other)"
+    # first component that looks like a module, plus one level below it
+    keep = parts[:2]
+    # a trailing primitive (dot_general etc.) is not a module level
+    if len(keep) == 2 and re.match(r"^(dot_general|conv|add|mul|custom)", keep[1]):
+        keep = keep[:1]
+    return "/".join(keep)
+
+
+def format_table(
+    groups: Dict[str, Dict[str, float]],
+    step_time_s: Optional[float] = None,
+    peak_flops: Optional[float] = None,
+) -> str:
+    """Render the per-module table (sorted by total FLOPs, descending).
+    When step_time_s is given, a proportional-time estimate column is added
+    (FLOPs share x measured step time — an estimate, not a measured
+    per-module latency)."""
+    total = sum(v["fwd"] + v["bwd"] for v in groups.values()) or 1.0
+    rows = sorted(groups.items(), key=lambda kv: -(kv[1]["fwd"] + kv[1]["bwd"]))
+    lines = []
+    header = f"{'module':<28}{'fwd GFLOPs':>12}{'bwd GFLOPs':>12}{'total':>10}{'share':>8}"
+    if step_time_s:
+        header += f"{'~ms':>8}"
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name, v in rows:
+        t = v["fwd"] + v["bwd"]
+        line = (
+            f"{name:<28}{v['fwd'] / 1e9:>12.2f}{v['bwd'] / 1e9:>12.2f}"
+            f"{t / 1e9:>10.2f}{t / total:>8.1%}"
+        )
+        if step_time_s:
+            line += f"{t / total * step_time_s * 1e3:>8.2f}"
+        lines.append(line)
+    lines.append("-" * len(header))
+    foot = f"{'TOTAL':<28}{sum(v['fwd'] for v in groups.values()) / 1e9:>12.2f}" \
+           f"{sum(v['bwd'] for v in groups.values()) / 1e9:>12.2f}{total / 1e9:>10.2f}{'100%':>8}"
+    if step_time_s:
+        foot += f"{step_time_s * 1e3:>8.2f}"
+    lines.append(foot)
+    if step_time_s and peak_flops:
+        lines.append(
+            f"step {step_time_s * 1e3:.2f} ms | {total / step_time_s / 1e12:.1f} TF/s "
+            f"achieved | {total / step_time_s / peak_flops:.1%} of peak"
+        )
+    return "\n".join(lines)

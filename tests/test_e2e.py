@@ -96,7 +96,7 @@ def trained_vae(shapes_dataset, tmp_path_factory):
         "--hidden_dim", "16",
         "--num_resnet_blocks", "1",
         "--batch_size", "8",
-        "--epochs", "15",
+        "--epochs", "4",
         "--learning_rate", "3e-3",
         "--output_file_name", str(ckpt),
         "--samples_dir", str(work / "samples"),
@@ -153,7 +153,7 @@ def trained_dalle(shapes_dataset, trained_vae, tmp_path_factory):
         "--dim_head", "16",
         "--text_seq_len", "16",
         "--batch_size", "8",
-        "--epochs", "11",
+        "--epochs", "6",
         "--learning_rate", "1e-3",
         "--truncate_captions",
         "--dalle_output_file_name", str(out),
@@ -170,7 +170,7 @@ def trained_dalle(shapes_dataset, trained_vae, tmp_path_factory):
         mp.undo()
     ckpt = Path(f"{out}.ckpt")
     assert ckpt.exists()
-    # loss at the end of training (22 steps) must be below the first-step
+    # loss at the end of training (12 steps) must be below the first-step
     # loss — the notebook's "training works" assertion
     assert len(losses) >= 2
     assert losses[-1] < losses[0], f"DALLE loss did not decrease: {losses}"
@@ -204,7 +204,7 @@ def test_train_cli_parallel_modes(shapes_dataset, trained_vae, tmp_path,
         "--dim_head", "16",
         "--text_seq_len", "16",
         "--batch_size", "8",
-        "--epochs", "2",
+        "--epochs", "1",
         "--learning_rate", "1e-3",
         "--truncate_captions",
         "--attn_types", attn_types,
@@ -292,7 +292,7 @@ def test_train_clip_cli_and_rerank(shapes_dataset, trained_dalle, tmp_path):
         "--visual_patch_size", "8",
         "--truncate_captions",
         "--batch_size", "8",
-        "--epochs", "4",
+        "--epochs", "2",
         "--learning_rate", "2e-3",
         "--clip_output_file_name", str(out),
     ]
@@ -310,7 +310,7 @@ def test_train_clip_cli_and_rerank(shapes_dataset, trained_dalle, tmp_path):
     # resume: params AND Adam moments restore (epoch counter advances)
     argv_resume = ["--clip_path", str(ckpt)] + [
         a for a in argv if a not in ("--clip_output_file_name", str(out))
-    ] + ["--clip_output_file_name", str(out), "--epochs", "6"]
+    ] + ["--clip_output_file_name", str(out), "--epochs", "3"]
     mp = pytest.MonkeyPatch()
     try:
         resume_losses = _capture_losses(mp)

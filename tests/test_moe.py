@@ -78,7 +78,7 @@ class TestDALLEMoE:
     def make(self, **kw):
         return DALLE(
             dim=32,
-            depth=4,
+            depth=2,
             num_text_tokens=64,
             text_seq_len=8,
             num_image_tokens=32,
@@ -195,7 +195,7 @@ class TestMoEMemoryModes:
 
     def make(self, **kw):
         return DALLE(
-            dim=32, depth=4, num_text_tokens=30, text_seq_len=6,
+            dim=32, depth=2, num_text_tokens=30, text_seq_len=6,
             num_image_tokens=16, image_fmap_size=3, heads=2, dim_head=8,
             attn_types=("full",), shift_tokens=False,
             ff_experts=4, moe_every=2, **kw,

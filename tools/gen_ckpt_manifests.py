@@ -220,33 +220,45 @@ def check_against_real(real_dir: str):
             for k, v in sd.items()
         }
 
+    def diff(actual, derived):
+        """Human-diagnosable differences: missing/extra keys AND per-key
+        shape/dtype drift (a same-key resized or fp16-stored tensor must
+        be reported, not just detected)."""
+        out = []
+        for k in sorted(set(actual) | set(derived)):
+            if k not in actual:
+                out.append(f"missing from real: {k}")
+            elif k not in derived:
+                out.append(f"unexpected in real: {k}")
+            elif actual[k] != derived[k]:
+                out.append(f"{k}: real {actual[k]} != manifest {derived[k]}")
+        return out
+
     real = Path(real_dir)
     problems = []
     for fname, derived in (
         ("encoder.pkl", openai_dvae_manifest("encoder")),
         ("decoder.pkl", openai_dvae_manifest("decoder")),
     ):
-        actual = inventory(load_torch_checkpoint(str(real / fname)))
-        if {k: v["shape"] for k, v in actual.items()} != {
-            k: v["shape"] for k, v in derived.items()
-        }:
-            problems.append((fname, set(actual) ^ set(derived)))
+        d = diff(inventory(load_torch_checkpoint(str(real / fname))), derived)
+        if d:
+            problems.append((fname, d))
     ckpt = real / "last.ckpt"
     if ckpt.exists():
         actual = {
             k: v for k, v in inventory(load_torch_checkpoint(str(ckpt))).items()
             if not k.startswith("loss.")
         }
-        derived = vqgan_manifest()
-        if {k: v["shape"] for k, v in actual.items()} != {
-            k: v["shape"] for k, v in derived.items()
-        }:
-            problems.append(("last.ckpt", set(actual) ^ set(derived)))
+        d = diff(actual, vqgan_manifest())
+        if d:
+            problems.append(("last.ckpt", d))
     if problems:
-        for fname, diff in problems:
-            print(f"MISMATCH {fname}: {sorted(diff)[:20]}")
+        for fname, d in problems:
+            print(f"MISMATCH {fname} ({len(d)} differences):")
+            for line in d[:30]:
+                print(f"  {line}")
         raise SystemExit(1)
-    print("real checkpoints match the derived manifests")
+    print("real checkpoints match the derived manifests (shapes AND dtypes)")
 
 
 if __name__ == "__main__":
