@@ -189,12 +189,29 @@ class PatternAttention(nn.Module):
             rotary_pos_emb = jnp.asarray(rot_static.table)
 
         if decode:
-            # decode stays in (b, n, h, d) end to end: the K/V caches live
-            # n-major, so the cache-wide dots stream (L, h*d) rows and the
-            # per-step head transposes disappear entirely
-            q, k, v = (t.reshape(b, n, h, d) for t in jnp.split(qkv, 3, axis=-1))
-            out = self._decode_attend(q, k, v, mask, rotary_pos_emb)
-            out = out.reshape(b, n, inner)
+            from . import decode_attention as _dk
+
+            if (
+                _dk.FUSED_DECODE_ENABLED
+                and n == 1
+                and self.use_flash
+                and self.attn_type == "full"
+                and self.causal
+                and _dk.fused_decode_supported(h, d)
+            ):
+                # OPT-IN fused decode kernel (ops/decode_attention.py):
+                # measured SLOWER than the XLA op chain on v5e (see that
+                # module's docstring), so off unless DALLE_TPU_FUSED_DECODE=1
+                out = self._decode_attend_fused(qkv, mask, rotary_pos_emb)
+            else:
+                # multi-token prefill blocks and non-"full" patterns: the
+                # unfused path, (b, n, h, d) end to end against the same
+                # n-major caches the kernel aliases
+                q, k, v = (
+                    t.reshape(b, n, h, d) for t in jnp.split(qkv, 3, axis=-1)
+                )
+                out = self._decode_attend(q, k, v, mask, rotary_pos_emb)
+                out = out.reshape(b, n, inner)
         else:
             from ..parallel.context import sp_extent
 
@@ -497,6 +514,70 @@ class PatternAttention(nn.Module):
 
     # ------------------------------------------------------------ decode path
 
+    def _decode_caches(self, b, dtype):
+        """The decode cache variables — ONE declaration shared by the fused
+        and unfused paths, so prefill (unfused) composes with fused
+        per-token steps on bit-identical caches."""
+        h, d, L = self.heads, self.dim_head, self.seq_len
+        is_init = not self.has_variable("cache", "cached_key")
+        cached_key = self.variable(
+            "cache", "cached_key", jnp.zeros, (b, L, h, d), dtype
+        )
+        cached_value = self.variable(
+            "cache", "cached_value", jnp.zeros, (b, L, h, d), dtype
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.array(0, dtype=jnp.int32)
+        )
+        return cached_key, cached_value, cache_index, is_init
+
+    def _decode_attend_fused(self, qkv, mask, rotary_pos_emb):
+        """Single-token decode through the fused Pallas kernel
+        (ops/decode_attention.py)."""
+        from .decode_attention import fused_decode_attention
+        from .rotary import _rotate_half_matrix
+
+        b = qkv.shape[0]
+        h, d = self.heads, self.dim_head
+        L = self.seq_len
+
+        cached_key, cached_value, cache_index, is_init = self._decode_caches(
+            b, qkv.dtype
+        )
+        if is_init:
+            return jnp.zeros((b, 1, h * d), qkv.dtype)
+
+        idx = cache_index.value
+        use_rotary = rotary_pos_emb is not None
+        if use_rotary:
+            # angles cast to the compute dtype before cos/sin, matching
+            # apply_rotary_emb (ops/rotary.py:82); the kernel widens to f32
+            ang = rotary_pos_emb.astype(qkv.dtype)
+            cos, sin = jnp.cos(ang), jnp.sin(ang)
+        else:
+            cos = jnp.zeros((L, d), qkv.dtype)
+            sin = cos
+        rot_p = jnp.asarray(_rotate_half_matrix(d), qkv.dtype)
+        key_mask = None if mask is None else mask[..., None].astype(jnp.int32)
+
+        out, k_row, v_row = fused_decode_attention(
+            qkv,
+            cached_key.value.reshape(b, L, h * d),
+            cached_value.value.reshape(b, L, h * d),
+            idx, cos, sin, rot_p, key_mask,
+            heads=h, dim_head=d, use_rotary=use_rotary,
+            interpret=jax.devices()[0].platform != "tpu",
+        )
+        upd = jax.lax.dynamic_update_slice_in_dim
+        cached_key.value = upd(
+            cached_key.value, k_row.reshape(b, 1, h, d), idx, axis=1
+        )
+        cached_value.value = upd(
+            cached_value.value, v_row.reshape(b, 1, h, d), idx, axis=1
+        )
+        cache_index.value = idx + 1
+        return out
+
     def _decode_attend(self, q, k, v, mask, rotary_pos_emb):
         """Decode against an n-major (b, L, h, d) K/V cache: single-token
         steps or multi-token prefill blocks (n > 1, e.g. the text prompt in
@@ -512,15 +593,8 @@ class PatternAttention(nn.Module):
         b, n, h, d = q.shape
         L = self.seq_len
 
-        is_init = not self.has_variable("cache", "cached_key")
-        cached_key = self.variable(
-            "cache", "cached_key", jnp.zeros, (b, L, h, d), k.dtype
-        )
-        cached_value = self.variable(
-            "cache", "cached_value", jnp.zeros, (b, L, h, d), v.dtype
-        )
-        cache_index = self.variable(
-            "cache", "cache_index", lambda: jnp.array(0, dtype=jnp.int32)
+        cached_key, cached_value, cache_index, is_init = self._decode_caches(
+            b, k.dtype
         )
         if is_init:
             return jnp.zeros_like(q)

@@ -1,0 +1,167 @@
+"""Fused single-token decode attention (ops/decode_attention.py): kernel
+parity vs the unfused decode math, model-level decode-vs-forward logits
+consistency, and dispatch conditions. Interpret mode on CPU.
+
+The kernel is an opt-in path (measured slower than the XLA chain on v5e —
+module docstring); model-level tests flip FUSED_DECODE_ENABLED on."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.models import DALLE
+from dalle_pytorch_tpu.ops.decode_attention import fused_decode_attention
+from dalle_pytorch_tpu.ops.rotary import _rotate_half_matrix
+
+
+def _oracle(qkv, kc, vc, idx, cos, sin, P, km, h, d, rotary=True):
+    """The unfused decode math (ops/attention.py:_decode_attend)."""
+    b, L, _ = kc.shape
+    q, k, v = (t.reshape(b, 1, h, d) for t in jnp.split(qkv, 3, axis=-1))
+    if rotary:
+        def rot(t):
+            return t * cos[idx][None, None, None] + (t @ P) * sin[idx][None, None, None]
+        q, k, v = rot(q), rot(k), rot(v)
+    kcr = kc.reshape(b, L, h, d).at[:, idx].set(k[:, 0])
+    vcr = vc.reshape(b, L, h, d).at[:, idx].set(v[:, 0])
+    s = jnp.einsum("bnhd,blhd->bhnl", q * d**-0.5, kcr)
+    allowed = (jnp.arange(L) <= idx)[None, None, None, :]
+    if km is not None:
+        allowed = allowed & km[:, None, None, :]
+    s = jnp.where(allowed, s, -1e30)
+    att = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhnl,blhd->bnhd", att, vcr).reshape(b, 1, h * d)
+    return out, kcr.reshape(b, L, h * d), vcr.reshape(b, L, h * d)
+
+
+@pytest.mark.parametrize("rotary", [True, False])
+@pytest.mark.parametrize("masked", [True, False])
+def test_kernel_matches_unfused_math(rotary, masked):
+    b, L, h, d = 2, 32, 4, 64
+    rng = np.random.RandomState(0)
+    qkv = jnp.asarray(rng.randn(b, 1, 3 * h * d), jnp.float32)
+    kc = jnp.asarray(rng.randn(b, L, h * d) * 0.1, jnp.float32)
+    vc = jnp.asarray(rng.randn(b, L, h * d) * 0.1, jnp.float32)
+    cos = jnp.asarray(np.cos(rng.rand(L, d)), jnp.float32)
+    sin = jnp.asarray(np.sin(rng.rand(L, d)), jnp.float32)
+    P = jnp.asarray(_rotate_half_matrix(d), jnp.float32)
+    km = None
+    if masked:
+        km_np = rng.rand(b, L) > 0.3
+        km_np[:, 0] = True
+        km = jnp.asarray(km_np)
+    idx = 7
+
+    out, k_row, v_row = fused_decode_attention(
+        qkv, kc, vc, idx, cos, sin, P,
+        None if km is None else km[..., None].astype(jnp.int32),
+        heads=h, dim_head=d, use_rotary=rotary, interpret=True,
+    )
+    ref, kcr, vcr = _oracle(qkv, kc, vc, idx, cos, sin, P, km, h, d, rotary)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+    # the emitted rows are what the caller writes into the caches at idx
+    np.testing.assert_allclose(
+        np.asarray(k_row[:, 0]), np.asarray(kcr.reshape(b, L, h * d)[:, idx]),
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(v_row[:, 0]), np.asarray(vcr.reshape(b, L, h * d)[:, idx]),
+        atol=1e-6,
+    )
+
+
+def _kernel_dalle(**kw):
+    """dim_head=64 so the fused kernel's head-group constraint holds."""
+    cfg = dict(
+        dim=128, depth=2, num_text_tokens=50, text_seq_len=6,
+        num_image_tokens=32, image_fmap_size=3, heads=2, dim_head=64,
+        attn_types=("full",), shift_tokens=False,
+    )
+    cfg.update(kw)
+    return DALLE(**cfg)
+
+
+def test_dalle_decode_dispatches_kernel_and_matches_forward(monkeypatch):
+    """decode_step must route single-token steps through the fused kernel
+    (spied, opt-in flag on) and reproduce the full-forward logits at every
+    position."""
+    import dalle_pytorch_tpu.ops.attention as A
+
+    calls = []
+    real = fused_decode_attention
+
+    def spy(*a, **k):
+        calls.append(1)
+        return real(*a, **k)
+
+    import dalle_pytorch_tpu.ops.decode_attention as DK
+
+    monkeypatch.setattr(DK, "FUSED_DECODE_ENABLED", True)
+    monkeypatch.setattr(DK, "fused_decode_attention", spy)
+
+    dalle = _kernel_dalle()
+    rng = np.random.RandomState(0)
+    text = jnp.asarray(rng.randint(1, 50, (2, 6)), jnp.int32)
+    image = jnp.asarray(rng.randint(0, 9, (2, 9)), jnp.int32)
+    params = dalle.init(jax.random.key(0), text, image)["params"]
+    full_logits = np.asarray(dalle.apply({"params": params}, text, image))
+
+    from dalle_pytorch_tpu.models.sampling import init_decode_cache
+
+    internal = np.concatenate(
+        (np.asarray(dalle.remap_text(text)), np.asarray(image)), axis=1
+    )
+    cache = init_decode_cache(dalle, params, batch_size=2)
+    for i in range(dalle.total_seq_len):
+        step_logits, mutated = dalle.apply(
+            {"params": params, "cache": cache},
+            jnp.asarray(internal[:, i]),
+            jnp.array(i, jnp.int32),
+            method=DALLE.decode_step,
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        np.testing.assert_allclose(
+            np.asarray(step_logits), full_logits[:, i],
+            atol=2e-3, rtol=1e-3,
+            err_msg=f"fused decode/forward mismatch at position {i}",
+        )
+    assert calls, "fused decode kernel never dispatched"
+
+
+def test_dalle_generation_through_kernel(monkeypatch):
+    import dalle_pytorch_tpu.ops.decode_attention as DK
+
+    monkeypatch.setattr(DK, "FUSED_DECODE_ENABLED", True)
+    from dalle_pytorch_tpu.models.sampling import generate_image_tokens
+
+    dalle = _kernel_dalle()
+    rng = np.random.RandomState(1)
+    text = jnp.asarray(rng.randint(1, 50, (2, 6)), jnp.int32)
+    image = jnp.asarray(rng.randint(0, 9, (2, 9)), jnp.int32)
+    params = dalle.init(jax.random.key(0), text, image)["params"]
+    toks = np.asarray(generate_image_tokens(dalle, params, text, jax.random.key(2)))
+    assert toks.shape == (2, 9)
+    assert (toks >= 0).all() and (toks < 32).all()
+
+
+def test_small_head_dims_fall_back(monkeypatch):
+    """dim_head=16 (hpb=8 > heads) must keep the unfused path."""
+    import dalle_pytorch_tpu.ops.decode_attention as DK
+
+    def boom(*a, **k):
+        raise AssertionError("fused kernel dispatched for unsupported heads")
+
+    monkeypatch.setattr(DK, "FUSED_DECODE_ENABLED", True)
+    monkeypatch.setattr(DK, "fused_decode_attention", boom)
+    dalle = _kernel_dalle(dim=64, heads=4, dim_head=16)
+    rng = np.random.RandomState(2)
+    text = jnp.asarray(rng.randint(1, 50, (1, 6)), jnp.int32)
+    image = jnp.asarray(rng.randint(0, 9, (1, 9)), jnp.int32)
+    params = dalle.init(jax.random.key(0), text, image)["params"]
+
+    from dalle_pytorch_tpu.models.sampling import generate_image_tokens
+
+    toks = np.asarray(generate_image_tokens(dalle, params, text, jax.random.key(3)))
+    assert toks.shape == (1, 9)
