@@ -337,13 +337,13 @@ class Transformer(nn.Module):
                 f"(not mlp/sparse, whose layers are heterogeneous); got "
                 f"{self.attn_types}"
             )
-        if self.ff_experts > 0:
+        if self.ff_experts > 0 and self.moe_every != 1:
             raise ValueError(
-                "pipeline parallelism excludes MoE feed-forwards: the "
-                "dense/MoE layer alternation breaks stage homogeneity, and "
-                "the GPipe layer_fn drops the blocks' (delta, aux) aux "
-                "channel — lifting this guard requires threading aux "
-                "through the stage schedule"
+                "pipeline parallelism requires homogeneous stages: with "
+                "MoE feed-forwards every layer must be MoE (set "
+                f"moe_every=1; got moe_every={self.moe_every}, whose "
+                "dense/MoE alternation gives stages different param "
+                "structures)"
             )
         if self.reversible:
             raise ValueError("pipeline parallelism excludes reversible mode")
@@ -412,10 +412,10 @@ class Transformer(nn.Module):
                 )
                 akw["rng"] = jax.random.fold_in(lk, 0)
                 fkw["rng"] = jax.random.fold_in(lk, 1)
-            d, _ = attn_f(p["attn"], t, akw)
+            d, a1 = attn_f(p["attn"], t, akw)
             t = t + d
-            d, _ = ff_f(p["ff"], t, fkw)
-            return t + d
+            d, a2 = ff_f(p["ff"], t, fkw)
+            return t + d, a1 + a2
 
         if self.remat:
             # honor --remat inside the pipeline: recompute each layer's
@@ -437,13 +437,19 @@ class Transformer(nn.Module):
                 side=s,
             )
 
-        return jax.shard_map(
+        out, aux = jax.shard_map(
             body, mesh=mesh,
             in_specs=(p_specs, x_spec, side_specs, key_spec),
-            out_specs=x_spec,
+            out_specs=(x_spec, P()),
             axis_names=frozenset({self.pp_axis}),
             check_vma=False,
         )(stacked, x, side, base_key)
+        if self.ff_experts > 0:
+            # per-microbatch Switch aux averaged over microbatches — a
+            # consistent estimator of the sequential path's full-batch aux
+            # (equal when routing statistics match across microbatches)
+            self.sow("moe_aux", "load_balance", aux / n_micro)
+        return out
 
     def _pure_blocks(self, mask, rot, deterministic, with_rng=True):
         """Unbound-apply closures + param subtrees + traced-array kwargs for

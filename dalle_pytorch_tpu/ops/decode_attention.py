@@ -142,6 +142,10 @@ def _kernel(
         )
         s = jnp.where(live_rows, s, NEG_INF)
         s_new = jnp.sum(kq[:, sl] * qs[:, sl])                     # scalar
+        # a key-padding-masked current token must not poison the softmax
+        # max: its raw score could exceed every live score by enough to
+        # underflow them all (making the output spuriously zero)
+        s_new = jnp.where(new_live > 0, s_new, NEG_INF)
         m = jnp.maximum(jnp.max(s), s_new)
         p = jnp.where(live_rows, jnp.exp(s - m), 0.0)              # (L, 1)
         p_new = jnp.exp(s_new - m) * new_live

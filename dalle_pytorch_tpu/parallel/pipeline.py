@@ -60,18 +60,23 @@ def gpipe(
     n_stages: int,
     n_micro: int,
     side: Any = None,
-) -> jnp.ndarray:
+):
     """Per-shard GPipe body (run under ``shard_map``).
 
-    ``layer_fn(layer_params, x, side, layer_idx, micro_idx) -> x`` applies
-    ONE layer; ``layer_idx`` (global, traced) and ``micro_idx`` identify the
-    (layer, microbatch) coordinate for RNG folding. ``stacked_params``: local
-    (1, layers_per_stage, ...) leaves (this stage's slice of the global
-    (n_layers, ...) stack). x: the FULL local batch (b, n, d) — split into
-    ``n_micro`` microbatches along dim 0. ``side``: optional pytree of
-    per-sample inputs (leading dim b, e.g. the key-padding mask), replicated
-    over pp; each stage indexes the rows matching its current microbatch.
-    Returns the full (b, n, d) output, identical on every pp rank.
+    ``layer_fn(layer_params, x, side, layer_idx, micro_idx) -> (x, aux)``
+    applies ONE layer and returns a scalar aux side-output (the Switch MoE
+    load-balance loss; 0.0 for dense layers); ``layer_idx`` (global,
+    traced) and ``micro_idx`` identify the (layer, microbatch) coordinate
+    for RNG folding. ``stacked_params``: local (1, layers_per_stage, ...)
+    leaves (this stage's slice of the global (n_layers, ...) stack). x: the
+    FULL local batch (b, n, d) — split into ``n_micro`` microbatches along
+    dim 0. ``side``: optional pytree of per-sample inputs (leading dim b,
+    e.g. the key-padding mask), replicated over pp; each stage indexes the
+    rows matching its current microbatch.
+
+    Returns ``(out, aux_total)``: the full (b, n, d) output and the aux sum
+    over every (layer, microbatch) — fill/drain garbage ticks excluded —
+    both identical on every pp rank.
     """
     stage = jax.lax.axis_index(axis_name)
     b = x.shape[0]
@@ -94,16 +99,18 @@ def gpipe(
         p_local = jax.tree_util.tree_map(lambda l: l[0], stacked_params)
         cur_side = pick(micro_side, micro_idx)
         y = carry_x
+        aux = jnp.zeros((), jnp.float32)
         for li in range(lps):
             p_layer = jax.tree_util.tree_map(lambda l, li=li: l[li], p_local)
-            y = layer_fn(p_layer, y, cur_side, stage * lps + li, micro_idx)
-        return y
+            y, a = layer_fn(p_layer, y, cur_side, stage * lps + li, micro_idx)
+            aux = aux + a
+        return y, aux
 
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     n_ticks = n_micro + n_stages - 1
 
     def tick(carry, t):
-        buf = carry  # (mb, n, d): activation entering this stage this tick
+        buf, aux_acc = carry  # activation entering this stage + aux sum
         # stage 0 picks up microbatch t (clamped; ticks >= n_micro feed
         # garbage that never reaches the collected outputs)
         feed = pick(micro, jnp.minimum(t, n_micro - 1))
@@ -111,18 +118,27 @@ def gpipe(
         # the microbatch index this stage processes at tick t (clamped on the
         # fill/drain garbage ticks; their outputs are never collected)
         micro_idx = jnp.clip(t - stage, 0, n_micro - 1)
-        out = stage_fn(inp, micro_idx)
+        out, aux = stage_fn(inp, micro_idx)
+        # a stage only holds real work for ticks stage <= t < stage+n_micro;
+        # garbage-tick aux (like garbage-tick outputs) must not accumulate
+        valid = jnp.logical_and(t >= stage, t - stage < n_micro)
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
         # collect: the last stage emits microbatch t - (n_stages - 1)
         emit = jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out))
         nxt = jax.lax.ppermute(out, axis_name, perm)
-        return nxt, emit
+        return (nxt, aux_acc), emit
 
     zeros = jnp.zeros((mb, *x.shape[1:]), x.dtype)
-    _, emitted = jax.lax.scan(tick, zeros, jnp.arange(n_ticks, dtype=jnp.int32))
+    (_, aux_local), emitted = jax.lax.scan(
+        tick, (zeros, jnp.zeros((), jnp.float32)),
+        jnp.arange(n_ticks, dtype=jnp.int32),
+    )
 
     # emitted[t] is live only on the last stage and only for ticks
     # t >= n_stages - 1 (microbatch index t - n_stages + 1); a single psum
-    # replicates the collected outputs to every pp rank
+    # replicates the collected outputs (and each stage's aux partial sum)
+    # to every pp rank
     out = emitted[n_stages - 1 :]
     out = jax.lax.psum(out, axis_name)
-    return out.reshape(b, *x.shape[1:])
+    aux_total = jax.lax.psum(aux_local, axis_name)
+    return out.reshape(b, *x.shape[1:]), aux_total

@@ -165,3 +165,33 @@ def test_small_head_dims_fall_back(monkeypatch):
 
     toks = np.asarray(generate_image_tokens(dalle, params, text, jax.random.key(3)))
     assert toks.shape == (1, 9)
+
+
+def test_masked_own_key_with_extreme_score():
+    """A key-padding-masked current position with a huge self-score must not
+    poison the softmax max (review finding: exp underflow zeroed the whole
+    row where the unfused path attends correctly over live keys)."""
+    b, L, h, d = 1, 16, 2, 64
+    rng = np.random.RandomState(3)
+    qkv = jnp.asarray(rng.randn(b, 1, 3 * h * d), jnp.float32)
+    # make q . k_new enormous: q and k_new aligned and large
+    big = jnp.ones((b, 1, h * d), jnp.float32) * 30.0
+    qkv = jnp.concatenate([big, big, qkv[..., 2 * h * d:]], axis=-1)
+    kc = jnp.asarray(rng.randn(b, L, h * d) * 0.1, jnp.float32)
+    vc = jnp.asarray(rng.randn(b, L, h * d) * 0.1, jnp.float32)
+    cos = jnp.asarray(np.cos(rng.rand(L, d)), jnp.float32)
+    sin = jnp.asarray(np.sin(rng.rand(L, d)), jnp.float32)
+    P = jnp.asarray(_rotate_half_matrix(d), jnp.float32)
+    idx = 7
+    km = np.ones((b, L), bool)
+    km[:, idx] = False  # the current token's own key is padded out
+
+    out, _, _ = fused_decode_attention(
+        qkv, kc, vc, idx, cos, sin, P,
+        jnp.asarray(km[..., None], jnp.int32),
+        heads=h, dim_head=d, use_rotary=False, interpret=True,
+    )
+    ref, _, _ = _oracle(qkv, kc, vc, idx, cos, sin, P, jnp.asarray(km),
+                        h, d, rotary=False)
+    assert np.abs(np.asarray(out)).max() > 0, "output spuriously zeroed"
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)

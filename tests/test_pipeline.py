@@ -20,7 +20,7 @@ def pp_mesh(n=4):
 
 
 def toy_layer(p, x, side, layer_idx, micro_idx):
-    return jnp.tanh(x @ p["w"] + p["b"])
+    return jnp.tanh(x @ p["w"] + p["b"]), jnp.zeros((), jnp.float32)
 
 
 @pytest.mark.parametrize("n_micro", [1, 2, 4])
@@ -38,7 +38,7 @@ def test_gpipe_matches_sequential(n_micro):
 
     expected = x
     for p in per_layer:
-        expected = toy_layer(p, expected, None, 0, 0)
+        expected, _ = toy_layer(p, expected, None, 0, 0)
 
     stacked = stack_layer_params(per_layer)
     stacked = jax.tree_util.tree_map(
@@ -54,12 +54,13 @@ def test_gpipe_matches_sequential(n_micro):
             ),
             mesh=mesh,
             in_specs=(p_specs, P(None)),
-            out_specs=P(None),
+            out_specs=(P(None), P()),
             check_vma=False,
         )
     )
-    out = fn(stacked, x)
+    out, aux = fn(stacked, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
+    assert float(aux) == 0.0
 
 
 def test_gpipe_gradients_match_sequential():
@@ -78,7 +79,7 @@ def test_gpipe_gradients_match_sequential():
     def seq_loss(layers):
         t = x
         for p in layers:
-            t = toy_layer(p, t, None, 0, 0)
+            t, _ = toy_layer(p, t, None, 0, 0)
         return (t * w).sum()
 
     g_seq = jax.jit(jax.grad(seq_loss))(per_layer)
@@ -91,13 +92,13 @@ def test_gpipe_gradients_match_sequential():
             lambda l: l.reshape(stages, depth // stages, *l.shape[1:]), stacked
         )
         p_specs = jax.tree_util.tree_map(lambda _: P("pp"), stacked)
-        out = jax.shard_map(
+        out, _ = jax.shard_map(
             functools.partial(
                 gpipe, toy_layer, axis_name="pp", n_stages=stages, n_micro=2
             ),
             mesh=mesh,
             in_specs=(p_specs, P(None)),
-            out_specs=P(None),
+            out_specs=(P(None), P()),
             check_vma=False,
         )(stacked, x)
         return (out * w).sum()
@@ -266,4 +267,48 @@ def test_dalle_pp_dropout_trains_deterministically():
         _, g = jax.jit(jax.value_and_grad(lambda p: pp_model.apply(
             {"params": p}, text, image, return_loss=True,
             deterministic=False, rngs={"dropout": jax.random.key(3)})))(params)
+    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree_util.tree_leaves(g))
+
+
+def test_dalle_pp_moe_matches_sequential():
+    """MoE under pipeline parallelism (moe_every=1 keeps stages
+    homogeneous): loss must equal the sequential MoE model's, and the
+    microbatch-averaged Switch aux must track the full-batch aux."""
+    kw = dict(ff_experts=4, moe_every=1, moe_capacity_factor=4.0)
+    base = tiny_dalle(None, **kw)
+    pp_model = tiny_dalle("pp", **kw)
+    rng = np.random.RandomState(11)
+    text = jnp.asarray(rng.randint(1, 64, size=(4, 8)), jnp.int32)
+    image = jnp.asarray(rng.randint(0, 32, size=(4, 16)), jnp.int32)
+    params = base.init(jax.random.key(0), text, image)["params"]
+
+    def run(model, runtime=None):
+        def go(p):
+            out, mut = model.apply(
+                {"params": p}, text, image, return_loss=True,
+                mutable=["moe_aux"],
+            )
+            aux = sum(jax.tree_util.tree_leaves(mut["moe_aux"]))
+            return out, aux
+        if runtime is None:
+            return jax.jit(go)(params)
+        with runtime.activate():
+            return jax.jit(go)(params)
+
+    l0, a0 = run(base)
+    l1, a1 = run(pp_model, make_runtime(dp=2, fsdp=1, tp=1, sp=1, pp=4))
+    np.testing.assert_allclose(float(l0), float(l1), rtol=2e-5)
+    # generous capacity + identical routing per token => the microbatch
+    # average equals the full-batch aux up to routing-statistics noise
+    np.testing.assert_allclose(float(a0), float(a1), rtol=0.2)
+    assert float(a1) >= 1.0 - 1e-5
+
+    # gradients flow through the pipelined experts and gate
+    with make_runtime(dp=2, fsdp=1, tp=1, sp=1, pp=4).activate():
+        _, g = jax.jit(jax.value_and_grad(
+            lambda p: pp_model.apply(
+                {"params": p}, text, image, return_loss=True,
+                mutable=["moe_aux"],
+            )[0]
+        ))(params)
     assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree_util.tree_leaves(g))
