@@ -4,6 +4,7 @@ tar-shard streaming."""
 import io
 import random
 import tarfile
+from pathlib import Path
 
 import numpy as np
 import pytest
@@ -318,3 +319,43 @@ ENTRY e {
         groups = parse_hlo_flops(hlo, custom_call_flops=cc)
         assert groups["attn[pallas]"]["fwd"] == 123.0
         assert groups["head"]["bwd"] == 2 * 4 * 2 * 8
+
+
+def test_analyze_trace_tool(tmp_path):
+    """tools/analyze_trace.py digests a Chrome-format profiler trace into
+    the per-category table (the measured-time complement of
+    bench.py --breakdown)."""
+    import gzip
+    import json
+    import sys
+
+    tools = Path(__file__).resolve().parent.parent / "tools"
+    sys.path.insert(0, str(tools))
+    import analyze_trace
+
+    events = [
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 2,
+         "args": {"name": "XLA Ops"}},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "jit_train_step(123)",
+         "ts": 0.0, "dur": 100.0, "args": {}},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "fusion.7", "ts": 1.0,
+         "dur": 60.0, "args": {"hlo_category": "convolution fusion",
+                               "deduplicated_name": "fusion.1"}},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "fn.3", "ts": 62.0,
+         "dur": 30.0, "args": {"hlo_category": "custom-call"}},
+        # outside the module window: must be excluded
+        {"ph": "X", "pid": 1, "tid": 2, "name": "fusion.9", "ts": 200.0,
+         "dur": 50.0, "args": {"hlo_category": "loop fusion"}},
+    ]
+    out = analyze_trace.analyze(events, None, 10)
+    assert "jit_train_step" in out
+    assert "convolution fusion" in out and "custom-call" in out
+    assert "loop fusion" not in out  # outside the window
+    d = tmp_path / "prof"
+    (d / "plugins" / "profile" / "x").mkdir(parents=True)
+    with gzip.open(d / "plugins" / "profile" / "x" / "m.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    loaded = analyze_trace.load_trace(str(d))
+    assert analyze_trace.analyze(loaded, "train_step", 10) == out

@@ -303,12 +303,18 @@ def test_dalle_pp_moe_matches_sequential():
     np.testing.assert_allclose(float(a0), float(a1), rtol=0.2)
     assert float(a1) >= 1.0 - 1e-5
 
-    # gradients flow through the pipelined experts and gate
+    # gradients flow through the pipelined experts, gate AND the aux
+    # channel itself (the trainer's objective is loss + w * aux)
+    def objective(p):
+        out, mut = pp_model.apply(
+            {"params": p}, text, image, return_loss=True, mutable=["moe_aux"]
+        )
+        return out + 1e-2 * sum(jax.tree_util.tree_leaves(mut["moe_aux"]))
+
     with make_runtime(dp=2, fsdp=1, tp=1, sp=1, pp=4).activate():
-        _, g = jax.jit(jax.value_and_grad(
-            lambda p: pp_model.apply(
-                {"params": p}, text, image, return_loss=True,
-                mutable=["moe_aux"],
-            )[0]
-        ))(params)
-    assert all(np.isfinite(np.asarray(x)).all() for x in jax.tree_util.tree_leaves(g))
+        _, g = jax.jit(jax.value_and_grad(objective))(params)
+    flat = jax.tree_util.tree_leaves(g)
+    assert all(np.isfinite(np.asarray(x)).all() for x in flat)
+    # the aux term must actually reach the gates through the pipeline
+    gate_g = g["transformer"]["ff_0"]["fn"]["fn"]["fn"]["gate"]["kernel"]
+    assert np.abs(np.asarray(gate_g)).max() > 0
