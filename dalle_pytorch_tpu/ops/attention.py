@@ -625,6 +625,18 @@ class PatternAttention(nn.Module):
             "bhnl,blhd->bnhd", attn.astype(cached_value.value.dtype), cached_value.value
         )
 
+    # Decode cost accounting (int8 serving, v5e-1, measured by trace —
+    # tools/analyze_trace.py, 2026-07): of ~0.82 ms/token, the int8 weight
+    # matvecs take ~290 us (at/near HBM bandwidth — nothing left there),
+    # the QK+AV cache sweeps ~244 us, small ops ~100 us, head+sampling the
+    # rest. The sweeps run at only ~250 GB/s because dim_head=64 half-fills
+    # the 128-lane tiles of the (b, L, h, d) caches; a lane-packed
+    # reformulation (two heads per 128-lane tile, block-diagonal q) could
+    # in principle reclaim ~160 us/token, but the opt-in fused kernel
+    # (ops/decode_attention.py) that packs exactly that way measured
+    # slightly SLOWER than XLA's chain (skinny-MXU latency). This is the
+    # quantified frontier for any future decode-latency work.
+    #
     # NOTE on int8 K/V caches (measured, v5e-1, 2026-07): quantizing the
     # decode caches was tried two ways — int8 storage widened inside the
     # cache dots (0.94 ms/token) and native s8xs8->s32 MXU dots with rowwise
