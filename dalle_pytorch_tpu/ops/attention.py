@@ -74,9 +74,12 @@ Dtype = Any
 NEG_INF = -0.7 * float(np.finfo(np.float32).max)
 
 
-def _softmax(scores: jnp.ndarray, stable: bool) -> jnp.ndarray:
+def _softmax(scores: jnp.ndarray, stable: bool, axis: int = -1) -> jnp.ndarray:
     scores = scores.astype(jnp.float32)
-    return stable_softmax(scores) if stable else jax.nn.softmax(scores, axis=-1)
+    return (
+        stable_softmax(scores, axis=axis) if stable
+        else jax.nn.softmax(scores, axis=axis)
+    )
 
 
 def dense_attend(
@@ -615,6 +618,35 @@ class PatternAttention(nn.Module):
         )[None, None]  # (1, 1, n, L)
         if mask is not None:
             allowed = allowed & mask[:, None, None, :]
+
+        if n == 1 and d < 128 and 128 % d == 0 and h % (128 // d) == 0:
+            # lane-packed single-token sweeps: dim_head < 128 half-fills
+            # the vector lanes of the (L, h, d) cache tiles, capping the
+            # QK/AV sweeps at ~250 GB/s (trace-measured). Packing P=128/d
+            # heads per 128-lane tile with a block-diagonal q restores
+            # full-lane contractions — exact same arithmetic, better
+            # effective bandwidth on the serving hot loop.
+            P_ = 128 // d
+            G = h // P_
+            eye = jnp.eye(P_, dtype=q.dtype)
+            K2 = cached_key.value.reshape(b, L, G, P_ * d)
+            V2 = cached_value.value.reshape(b, L, G, P_ * d)
+            qr = q.reshape(b, G, P_, d)
+            qblk = jnp.einsum("bgpd,pq->bgpdq", qr, eye).reshape(b, G, P_ * d, P_)
+            s = jnp.einsum(
+                "blgc,bgcp->bglp", K2, qblk, preferred_element_type=jnp.float32
+            )
+            # allowed (b|1, 1, 1, L) -> (b|1, 1, L, 1) over s's (b, g, l, p)
+            s = jnp.where(allowed[:, :, 0, :, None], s, NEG_INF)
+            att = _softmax(s, self.stable, axis=2)
+            og = jnp.einsum(
+                "bglp,blgc->bgpc", att.astype(V2.dtype), V2
+            )  # (b, G, P, P*d); head p's output is its own 64-lane slice
+            out = jnp.stack(
+                [og[:, :, p, p * d:(p + 1) * d] for p in range(P_)], axis=2
+            )
+            return out.reshape(b, 1, h, d)
+
         scores = jnp.einsum(
             "bnhd,blhd->bhnl", q, cached_key.value,
             preferred_element_type=jnp.float32,
@@ -629,13 +661,14 @@ class PatternAttention(nn.Module):
     # tools/analyze_trace.py, 2026-07): of ~0.82 ms/token, the int8 weight
     # matvecs take ~290 us (at/near HBM bandwidth — nothing left there),
     # the QK+AV cache sweeps ~244 us, small ops ~100 us, head+sampling the
-    # rest. The sweeps run at only ~250 GB/s because dim_head=64 half-fills
-    # the 128-lane tiles of the (b, L, h, d) caches; a lane-packed
-    # reformulation (two heads per 128-lane tile, block-diagonal q) could
-    # in principle reclaim ~160 us/token, but the opt-in fused kernel
-    # (ops/decode_attention.py) that packs exactly that way measured
-    # slightly SLOWER than XLA's chain (skinny-MXU latency). This is the
-    # quantified frontier for any future decode-latency work.
+    # rest. The sweeps ran at only ~250 GB/s because dim_head=64 half-fills
+    # the 128-lane tiles of the (b, L, h, d) caches. The lane-packed XLA
+    # reformulation in _decode_attend above (P heads per 128-lane tile,
+    # block-diagonal q — exact arithmetic) recovers part of that: measured
+    # int8 0.823 -> 0.813 ms/token, bf16 1.044 -> 1.029 (reproduced twice).
+    # The same packing done as a Pallas kernel (ops/decode_attention.py)
+    # measured SLOWER than XLA's chain (skinny-MXU latency) and stays
+    # opt-in; the residual sweep inefficiency is the remaining frontier.
     #
     # NOTE on int8 K/V caches (measured, v5e-1, 2026-07): quantizing the
     # decode caches was tried two ways — int8 storage widened inside the

@@ -195,3 +195,40 @@ def test_masked_own_key_with_extreme_score():
                         h, d, rotary=False)
     assert np.abs(np.asarray(out)).max() > 0, "output spuriously zeroed"
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_lane_packed_decode_matches_forward_default_path():
+    """The DEFAULT decode path's lane-packed sweeps (attention.py:
+    _decode_attend, taken when 128 % dim_head == 0 and heads divide into
+    full tiles) must reproduce the full-forward logits — independent of the
+    opt-in fused kernel, which stays off here."""
+    import dalle_pytorch_tpu.ops.decode_attention as DK
+
+    assert not DK.FUSED_DECODE_ENABLED  # default path under test
+    dalle = _kernel_dalle()  # heads=2, dim_head=64 -> packed branch
+    rng = np.random.RandomState(5)
+    text = jnp.asarray(rng.randint(1, 50, (2, 6)), jnp.int32)
+    image = jnp.asarray(rng.randint(0, 9, (2, 9)), jnp.int32)
+    params = dalle.init(jax.random.key(0), text, image)["params"]
+    full_logits = np.asarray(dalle.apply({"params": params}, text, image))
+
+    from dalle_pytorch_tpu.models.sampling import init_decode_cache
+
+    internal = np.concatenate(
+        (np.asarray(dalle.remap_text(text)), np.asarray(image)), axis=1
+    )
+    cache = init_decode_cache(dalle, params, batch_size=2)
+    for i in range(dalle.total_seq_len):
+        step_logits, mutated = dalle.apply(
+            {"params": params, "cache": cache},
+            jnp.asarray(internal[:, i]),
+            jnp.array(i, jnp.int32),
+            method=DALLE.decode_step,
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        np.testing.assert_allclose(
+            np.asarray(step_logits), full_logits[:, i],
+            atol=2e-3, rtol=1e-3,
+            err_msg=f"lane-packed decode/forward mismatch at position {i}",
+        )
