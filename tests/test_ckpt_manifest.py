@@ -249,3 +249,26 @@ def test_real_vqgan_checkpoint_golden():
     recon = vae.apply({"params": params}, idx, method="decode")
     err = float(jnp.abs(recon - img).mean())
     assert err < 0.15, f"reconstruction error {err:.3f} too high for real weights"
+
+
+def test_generator_cli_is_idempotent(tmp_path, monkeypatch):
+    """Running tools/gen_ckpt_manifests.py must regenerate byte-identical
+    JSONs (the vendored files are exactly what the generator emits)."""
+    import sys
+
+    tools = Path(__file__).resolve().parent.parent / "tools"
+    if not tools.exists():
+        pytest.skip("generator lives in the repo tree, not the wheel")
+    sys.path.insert(0, str(tools))
+    import gen_ckpt_manifests as gen
+
+    monkeypatch.setattr(gen, "OUT_DIR", tmp_path)
+    gen.write_manifests()
+    for name in (
+        "openai_dvae_encoder.json",
+        "openai_dvae_decoder.json",
+        "vqgan_f16_1024.json",
+    ):
+        fresh = (tmp_path / name).read_text()
+        vendored = (MANIFEST_DIR / name).read_text()
+        assert fresh == vendored, f"{name} drifted from the generator output"
