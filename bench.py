@@ -312,7 +312,10 @@ def bench_breakdown(on_cpu: bool):
         if 'custom_call_target="tpu_custom_call"' not in line:
             return None
         head = line.split("custom-call(", 1)[0]
-        kind = "fwd" if "f32[" in head else "bwd"  # fwd returns the f32 lse
+        # count result tensors in the (possibly tuple) output shape: fwd
+        # returns 2 (out, lse), bwd returns the 3-tuple (dq, dk, dv); dtype
+        # substrings are unreliable in f32 runs
+        kind = "bwd" if head.count("[") >= 3 else "fwd"
         return ("transformer/attn[pallas]", kind, fwd_cc if kind == "fwd" else bwd_cc)
 
     groups = parse_hlo_flops(compiled.as_text(), custom_call_flops=cc_flops)

@@ -59,6 +59,7 @@ def parse_hlo_flops(
             defs[name] = tuple(int(d) for d in dims.split(",")) if dims else ()
 
     out: Dict[str, Dict[str, float]] = defaultdict(lambda: {"fwd": 0.0, "bwd": 0.0})
+    unparsed_dots = 0
 
     for line in hlo_text.splitlines():
         line = line.strip()
@@ -75,6 +76,11 @@ def parse_hlo_flops(
             args = _OPND_RE.findall(line.split(" dot(", 1)[1])
             lhs_shape = _shape_of(defs, args[0]) if args else ()
             cdims = re.search(r"lhs_contracting_dims=\{([\d,]*)\}", line)
+            if cdims and not lhs_shape:
+                # operand defined in a line form the regex didn't capture —
+                # surface the gap rather than silently charging contracted=1
+                unparsed_dots += 1
+                continue
             contracted = _prod(
                 lhs_shape[int(i)] for i in cdims.group(1).split(",") if i
             ) if (cdims and lhs_shape) else 1
@@ -105,6 +111,14 @@ def parse_hlo_flops(
         is_bwd = "transpose(" in op_name
         scope = scope_of(op_name)
         out[scope]["bwd" if is_bwd else "fwd"] += flops
+    if unparsed_dots:
+        import warnings
+
+        warnings.warn(
+            f"hlo_breakdown: {unparsed_dots} dot op(s) had unresolvable "
+            "operand shapes; their FLOPs are omitted from the table",
+            stacklevel=2,
+        )
     return dict(out)
 
 

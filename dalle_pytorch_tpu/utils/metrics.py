@@ -24,6 +24,7 @@ class MetricsLogger:
         enabled: bool = True,
         use_wandb: bool = False,
         log_file: Optional[str] = None,
+        entity: Optional[str] = None,
     ):
         self.enabled = enabled
         self._wandb = None
@@ -35,7 +36,8 @@ class MetricsLogger:
                 import wandb
 
                 self._wandb = wandb
-                wandb.init(project=project or "dalle_tpu", name=run_name, config=config)
+                wandb.init(project=project or "dalle_tpu", name=run_name,
+                           entity=entity, config=config)
             except ImportError:
                 print("wandb not installed; falling back to console logs", file=sys.stderr)
         if log_file:

@@ -25,15 +25,20 @@ import numpy as np
 import optax
 
 
-def parse_args():
+def build_parser():
     parser = argparse.ArgumentParser(description="Train DALL-E on TPU")
     group = parser.add_mutually_exclusive_group(required=False)
     group.add_argument("--vae_path", type=str, help="path to a trained DiscreteVAE checkpoint")
     group.add_argument("--dalle_path", type=str, help="path to a partially trained DALL-E to resume")
     parser.add_argument("--image_text_folder", type=str, required=True,
                         help="folder of images + same-stem .txt captions, or a .tar shard spec")
-    parser.add_argument("--wds", action="store_true",
-                        help="treat image_text_folder as a webdataset tar shard spec")
+    parser.add_argument("--wds", type=str, nargs="?", const="auto", default="",
+                        help="treat image_text_folder as a webdataset tar "
+                             "shard spec. Bare --wds auto-detects the "
+                             "image/caption member names; a value gives the "
+                             "comma-separated image,caption column names the "
+                             "reference takes (ref train_dalle.py:48-53), "
+                             "e.g. --wds img,cap")
     parser.add_argument("--truncate_captions", action="store_true")
     parser.add_argument("--random_resize_crop_lower_ratio", dest="resize_ratio",
                         type=float, default=0.75)
@@ -59,6 +64,9 @@ def parse_args():
     parser.add_argument("--amp", dest="bf16", action="store_true")
     parser.add_argument("--wandb", action="store_true")
     parser.add_argument("--wandb_name", default="dalle_train_transformer")
+    parser.add_argument("--wandb_entity", default=None,
+                        help="W&B entity (team/user) the run is logged under "
+                             "(ref train_dalle.py:83)")
     parser.add_argument("--stable_softmax", action="store_true")
     parser.add_argument("--seed", type=int, default=42)
 
@@ -132,7 +140,11 @@ def parse_args():
                              help="comma-separated: full, sparse, axial_row, axial_col, conv_like, mlp")
     model_group.add_argument("--shift_tokens", action="store_true")
     model_group.add_argument("--rotary_emb", action="store_true")
-    return parser.parse_args()
+    return parser
+
+
+def parse_args():
+    return build_parser().parse_args()
 
 
 def pick_tokenizer(args):
@@ -268,6 +280,12 @@ def main():
 
     # ---- data ------------------------------------------------------------
     if args.wds or args.image_text_folder.endswith(".tar"):
+        wds_spec = "" if args.wds == "auto" else args.wds
+        wds_cols = [c.strip() for c in wds_spec.split(",") if c.strip()]
+        if wds_cols and len(wds_cols) != 2:
+            raise SystemExit(
+                f"--wds wants 2 comma-separated column names (img,cap); got {args.wds!r}"
+            )
         dataset = TarImageTextDataset(
             args.image_text_folder,
             text_len=dalle.text_seq_len,
@@ -275,6 +293,8 @@ def main():
             truncate_captions=args.truncate_captions,
             resize_ratio=args.resize_ratio,
             tokenizer=tokenizer,
+            image_key=wds_cols[0] if len(wds_cols) == 2 else None,
+            caption_key=wds_cols[1] if len(wds_cols) == 2 else None,
             process_index=runtime.process_index,
             process_count=runtime.process_count,
         )
@@ -306,6 +326,7 @@ def main():
         config=vars(args),
         enabled=runtime.is_root_worker(),
         use_wandb=args.wandb,
+        entity=args.wandb_entity,
     )
 
     # ---- params / optimizer / compiled step ------------------------------
