@@ -99,15 +99,16 @@ class MeshRuntime:
 
     # ----------------------------------------------------------- collectives
 
-    def barrier(self) -> None:
+    def barrier(self, name: str = "MeshRuntime.barrier") -> None:
         """Block until all processes arrive (local_barrier,
-        distributed_backend.py:128-138)."""
+        distributed_backend.py:128-138). A pmap-over-local-devices psum is
+        NOT enough — in multi-process JAX each process pmaps only its own
+        addressable devices, so the reduction never leaves the host; the
+        sync must go through the cross-process allgather."""
         if jax.process_count() > 1:
-            # a tiny all-reduce across all devices acts as a barrier
-            x = jnp.ones((jax.local_device_count(),))
-            jax.block_until_ready(
-                jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x)
-            )
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)
 
     def average_all(self, value):
         """Mean of a per-process scalar across the world — the reference's
@@ -119,10 +120,12 @@ class MeshRuntime:
         """
         if jax.process_count() == 1:
             return value
-        arr = jnp.asarray(value)[None].repeat(jax.local_device_count(), 0)
-        return float(
-            jax.pmap(lambda v: jax.lax.pmean(v, "i"), axis_name="i")(arr)[0]
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(
+            jnp.asarray(value, jnp.float32)
         )
+        return float(np.mean(gathered))
 
     def to_host(self, tree):
         """Gather a (possibly multi-host-sharded) pytree to host numpy on
@@ -132,7 +135,9 @@ class MeshRuntime:
             return jax.tree_util.tree_map(np.asarray, tree)
         from jax.experimental import multihost_utils
 
-        return multihost_utils.process_allgather(tree)
+        # tiled: reassemble each sharded global array into its full global
+        # shape (tiled=False would stack a per-process leading dim)
+        return multihost_utils.process_allgather(tree, tiled=True)
 
     # -------------------------------------------------------------- specs
 
