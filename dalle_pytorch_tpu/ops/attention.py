@@ -45,11 +45,26 @@ from .layers import stable_softmax
 from .rotary import apply_rotary_emb
 
 
-@functools.lru_cache(maxsize=None)
+_FLASH_MASK_CACHE: dict = {}
+
+
 def _cached_flash_mask(module: "PatternAttention", n: int) -> StaticMask:
-    """One StaticMask per (module config, n) — flax modules are frozen
-    hashable dataclasses, so this builds each layer's mask exactly once."""
-    return StaticMask(module.pattern_mask()[:n, :n])
+    """One StaticMask per (pattern config, n), built exactly once. Keyed on
+    the fields ``pattern_mask()`` reads — NOT the module itself: a bound
+    flax module (inside apply, holding variables) is unhashable, so an
+    lru_cache over the module works at trace time only for unbound calls
+    and raises mid-apply."""
+    key = (
+        module.attn_type, module.seq_len, module.causal,
+        module.image_fmap_size, module.kernel_size, module.dilation,
+        module.block_size, module.num_random_blocks, module.layout_seed, n,
+    )
+    cached = _FLASH_MASK_CACHE.get(key)
+    if cached is None:
+        cached = _FLASH_MASK_CACHE[key] = StaticMask(
+            module.pattern_mask()[:n, :n]
+        )
+    return cached
 
 
 @functools.lru_cache(maxsize=None)
@@ -225,19 +240,24 @@ class PatternAttention(nn.Module):
             )
             # packed single-block path: q/k/v head slices stream straight
             # out of the projection layout, rotary applied in-kernel — no
-            # split/reshape/transpose/rotary sweeps through HBM
+            # split/reshape/transpose/rotary sweeps through HBM. EVERY
+            # pattern rides this kernel at flash-eligible shapes, with the
+            # non-full patterns streaming their static mask as an in-kernel
+            # operand — measured at the flagship shape (seq 1280, v5e), the
+            # kernel's full-square compute beats any grouped formulation
+            # that materializes scores in HBM (see the measurement note at
+            # _pattern_attend below)
             if (
                 not use_sp
                 and self.use_flash
                 and not force_dense
-                and self.attn_type in ("full", "sparse")
                 and _flash_block(n) == n
                 and fused_qkv_supported(n, h, d)
                 and (rotary_pos_emb is None or rot_static is not None)
             ):
                 pattern = (
                     _cached_flash_mask(self, n)
-                    if self.attn_type == "sparse" else None
+                    if self.attn_type != "full" else None
                 )
                 rot = (
                     _cached_rot_slice(rot_static, n)
@@ -265,7 +285,6 @@ class PatternAttention(nn.Module):
             elif (
                 self.use_flash
                 and not force_dense
-                and self.attn_type in ("full", "sparse")
                 and _flash_block(n) > 0
             ):
                 out = self._flash_attend(q, k, v, n, mask)
@@ -281,7 +300,7 @@ class PatternAttention(nn.Module):
     # ------------------------------------------------------------ flash path
 
     def _flash_attend(self, q, k, v, n: int, mask=None):
-        """Fused Pallas kernel for the dense-causal and block-sparse patterns
+        """Fused Pallas kernel for any static pattern
         (ops/flash_attention.py): O(n·d) memory, per-block skip of masked-out
         regions. A runtime (b, n) key-padding mask streams through the kernel
         as a fourth operand — no dense (n, n) fallback. The non-causal full
@@ -290,7 +309,7 @@ class PatternAttention(nn.Module):
         tests run anywhere."""
         block = _flash_block(n)
         pattern = None
-        if self.attn_type == "sparse":
+        if self.attn_type != "full":
             pattern = _cached_flash_mask(self, n)
         return flash_attention(
             q, k, v,
@@ -353,12 +372,46 @@ class PatternAttention(nn.Module):
         )(*args)
 
     def _pattern_attend(self, q, k, v, mask, force_dense: bool = False):
-        """Dispatch to this pattern's FLOP-efficient path (q pre-scaled)."""
+        """Dispatch to this pattern's FLOP-efficient path (q pre-scaled).
+
+        These grouped forms serve the non-flash shapes (CPU tests, decode
+        mask rows, seqs not divisible by 128). At flash-eligible shapes the
+        patterns ride the packed flash kernel instead — a measured decision
+        (flagship shape: depth 12, seq 1280, batch 8, v5e, 2026-07, via
+        bench.py --patterns):
+
+          full / packed flash kernel     134 ms/step   (59% MFU baseline)
+          sparse via flash pattern op    138 ms/step   (0.97x)
+          axial_row grouped (this file)  171 ms/step   (0.79x)
+          conv_like grouped, rolled      532 ms/step   (0.25x)
+
+        After routing every pattern through the flash pattern operand, all
+        four measure 136-137 ms (0.98x of full) at the flagship shape.
+
+        The grouped forms compute 5-40x fewer score FLOPs yet LOSE: with
+        attention only ~16% of the flagship step, their HBM-materialized
+        score tensors (the image-queries x text-keys f32 block alone is
+        537 MB/layer) cost more than the packed kernel's full-square MXU
+        compute, which keeps scores in VMEM. A trace of the rolled conv
+        path shows 51% loop-fusion + 17% layout-copy time — VPU/HBM work
+        XLA cannot turn back into matmuls. Ceiling check: even a perfect
+        axial kernel (~20% of full's score FLOPs, in-kernel) would save
+        only ~17 ms of 134 (1.15x) — not worth a bespoke Pallas kernel
+        next to the 0.97x the shared pattern path already delivers. The
+        patterns' value at TPU flash shapes is memory (O(n*d)) and
+        reference semantic parity, not speed; their compute win remains
+        real where it always was — shapes where flash cannot run."""
         if not force_dense:
             if self.attn_type in ("axial_row", "axial_col"):
                 return self._axial_attend(q, k, v, mask)
             if self.attn_type == "conv_like":
-                return self._conv_attend(q, k, v, mask)
+                # rematerialize the conv core in backward: its saved
+                # activations (f32 text+window score tensors, ~220 MB/layer
+                # at the flagship shape) pushed the 12-layer step past HBM
+                # (19.5 G > 15.75 G, measured), while recomputing the rolls
+                # and dots costs only O(f^2 ks^2 d) VPU work. No params or
+                # RNG inside — a pure jax.checkpoint is safe.
+                return jax.checkpoint(self._conv_attend)(q, k, v, mask)
         return self._dense_attend(q, k, v, mask)
 
     # ------------------------------------------------------------ dense paths
@@ -455,9 +508,19 @@ class PatternAttention(nn.Module):
         return ok.reshape(f * f, ks * ks)
 
     def _conv_attend(self, q, k, v, mask):
-        """Conv-like local attention via patch extraction — the XLA analog of
-        the reference's F.unfold over k/v feature maps (attention.py:156-158).
-        FLOPs for image-image: O(f^2 * ks^2 * d)."""
+        """Conv-like local attention via per-offset grid rolls — the TPU
+        analog of the reference's F.unfold over k/v feature maps
+        (attention.py:156-158), reformulated so no (b, h, f^2, ks^2, d)
+        window tensor is ever materialized: at the flagship shape those
+        patch tensors are 400 MB each and blew HBM (21.4 G > 15.75 G,
+        measured). Score k of query p is q[p]·k[p + off_k], so each of the
+        ks^2 window offsets is one 2-D roll of the k/v grids plus an
+        elementwise-product reduction over d — peak extra memory is the
+        (b, h, f^2, ks^2) score tensor (~13 MB) and one rolled grid
+        (~17 MB) instead. Wrapped-around roll entries land exactly where
+        ``_conv_window_mask`` already marks the window invalid (out-of-grid
+        or acausal), so masking is unchanged. FLOPs for image-image:
+        O(f^2 * ks^2 * d)."""
         b, h, n, d = q.shape
         f, tl, ks, dil = self.image_fmap_size, self.text_len, self.kernel_size, self.dilation
         pad = ((ks - 1) * dil + 1) // 2
@@ -472,46 +535,57 @@ class PatternAttention(nn.Module):
         tmask = tmask & key_mask if key_mask is not None else jnp.asarray(tmask)
         out_text = dense_attend(q_text, k_text, v_text, tmask, self.stable)
 
-        # extract k/v windows: (b, h, f, f, d) -> (b*h, d, f, f) -> patches
-        def patches(t):
-            t = t.transpose(0, 1, 4, 2, 3).reshape(b * h, d, f, f)
-            p = jax.lax.conv_general_dilated_patches(
-                t,
-                filter_shape=(ks, ks),
-                window_strides=(1, 1),
-                padding=((pad, pad), (pad, pad)),
-                rhs_dilation=(dil, dil),
-            )  # (b*h, d*ks*ks, f, f), channel-major ordering (d, ks*ks)
-            p = p.reshape(b, h, d, ks * ks, f * f)
-            return p.transpose(0, 1, 4, 3, 2)  # (b, h, p, ks*ks, d)
+        # window offsets in grid coordinates, row-major over the ks x ks
+        # kernel — the same ordering _conv_window_mask uses
+        offs = [
+            ((i * dil) - pad, (j * dil) - pad)
+            for i in range(ks) for j in range(ks)
+        ]
 
-        k_win, v_win = patches(k_img), patches(v_img)
+        def shifted(t, dy, dx):
+            # align k/v position (r+dy, c+dx) with query position (r, c)
+            return jnp.roll(t, shift=(-dy, -dx), axis=(2, 3))
+
+        dots_win = jnp.stack(
+            [
+                jnp.einsum(
+                    "bhrcd,bhrcd->bhrc", q_img, shifted(k_img, dy, dx),
+                    preferred_element_type=jnp.float32,
+                )
+                for dy, dx in offs
+            ],
+            axis=-1,
+        ).reshape(b, h, f * f, ks * ks)
         q_flat = q_img.reshape(b, h, f * f, d)
-
-        dots_win = jnp.einsum("bhpd,bhpkd->bhpk", q_flat, k_win, preferred_element_type=jnp.float32)
-        dots_text = jnp.einsum("bhpd,bhjd->bhpj", q_flat, k_text, preferred_element_type=jnp.float32)
+        dots_text = jnp.einsum(
+            "bhpd,bhjd->bhpj", q_flat, k_text,
+            preferred_element_type=jnp.float32,
+        )
 
         win_mask = jnp.asarray(self._conv_window_mask())[None, None]
         if mask is not None:
             img_mask = jnp.pad(mask[:, tl:], ((0, 0), (0, self.seq_len - mask.shape[1])))
-            img_mask = img_mask.reshape(-1, 1, f, f).astype(jnp.float32)
-            mask_patches = jax.lax.conv_general_dilated_patches(
-                img_mask,
-                filter_shape=(ks, ks),
-                window_strides=(1, 1),
-                padding=((pad, pad), (pad, pad)),
-                rhs_dilation=(dil, dil),
-            ).reshape(-1, ks * ks, f * f) > 0.5  # (b, ks*ks, p)
-            win_mask = win_mask & mask_patches.transpose(0, 2, 1)[:, None]
+            img_mask = img_mask.reshape(-1, f, f)
+            valid_k = jnp.stack(
+                [
+                    jnp.roll(img_mask, shift=(-dy, -dx), axis=(1, 2))
+                    for dy, dx in offs
+                ],
+                axis=-1,
+            ).reshape(-1, 1, f * f, ks * ks)  # (b, 1, p, ks*ks)
+            win_mask = win_mask & valid_k
             dots_text = jnp.where(mask[:, None, None, :tl], dots_text, NEG_INF)
         dots_win = jnp.where(win_mask, dots_win, NEG_INF)
 
         dots = jnp.concatenate((dots_text, dots_win), axis=-1)
         attn = _softmax(dots, self.stable).astype(v.dtype)
         attn_text, attn_win = attn[..., :tl], attn[..., tl:]
-        out_img = jnp.einsum("bhpk,bhpkd->bhpd", attn_win, v_win) + jnp.einsum(
-            "bhpj,bhjd->bhpd", attn_text, v_text
-        )
+        attn_grid = attn_win.reshape(b, h, f, f, ks * ks)
+        out_img = jnp.einsum("bhpj,bhjd->bhpd", attn_text, v_text)
+        out_img = out_img + sum(
+            (attn_grid[..., idx, None] * shifted(v_img, dy, dx))
+            for idx, (dy, dx) in enumerate(offs)
+        ).reshape(b, h, f * f, d)
         out_img = out_img[..., : n - tl, :]
         return jnp.concatenate((out_text, out_img), axis=2)
 

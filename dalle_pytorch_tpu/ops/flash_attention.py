@@ -113,7 +113,11 @@ def _masked_scores(q, k, sm_scale, mask_ref, kmask_ref, visit, row0, col0, bq, b
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * sm_scale
     if mask_ref is not None:
-        s = jnp.where(mask_ref[:] > 0, s, NEG_INF)
+        # widen the int8 operand before comparing: Mosaic on v5e cannot
+        # lower cmpi on the packed vector<..xi8> layout ("Target does not
+        # support this comparison"); the i8->i32 convert is supported and
+        # keeps the streamed mask at 1 byte/element
+        s = jnp.where(mask_ref[:].astype(jnp.int32) > 0, s, NEG_INF)
     else:
         rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + row0
         cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + col0

@@ -99,6 +99,14 @@ def parse_hlo_flops(
                 o_idx = rhs_labels.index("o" if "o" in rhs_labels else "f")
                 per_out = _prod(rhs_shape) // max(int(rhs_shape[o_idx]), 1)
                 flops = 2.0 * _prod(out_shape) * per_out
+                # lhs-dilated (transposed/grad) convs: the dilation factor of
+                # the multiplications hits inserted zeros and is never
+                # executed — XLA's cost model counts only real MACs, so
+                # divide to match (flags transposed decoder convs otherwise
+                # overcounted 4x at stride 2)
+                dil = re.search(r"lhs_dilate=([\dx]+)", line)
+                if dil:
+                    flops /= _prod(int(d) for d in dil.group(1).split("x"))
         elif "custom-call" in line and custom_call_flops is not None:
             acc = custom_call_flops(line)
             if acc:

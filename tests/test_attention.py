@@ -129,3 +129,26 @@ def test_non_causal_full():
     base = np.asarray(attn.apply(params, x0))
     out = np.asarray(attn.apply(params, x0.at[0, 7].add(1.0)))
     assert np.abs(out[0, 0] - base[0, 0]).max() > 1e-6
+
+
+@pytest.mark.parametrize("attn_type", ["axial_row", "axial_col", "conv_like", "sparse"])
+def test_flash_pattern_matches_grouped_at_flash_shape(attn_type):
+    """At flash-eligible shapes every pattern rides the packed flash kernel
+    with its static mask as an in-kernel operand (measured faster than the
+    grouped HBM-materialized forms at the flagship shape — note at
+    _pattern_attend). The kernel path must agree with the grouped/dense
+    oracle the parity tests pin to the reference."""
+    f, text_len = 8, 64
+    seq = text_len + f * f  # 128 — flash-eligible
+    attn_kw = dict(
+        dim=DIM, seq_len=seq, attn_type=attn_type, heads=HEADS,
+        dim_head=DIM_HEAD, image_fmap_size=f, block_size=16,
+        num_random_blocks=1,
+    )
+    x_big = jax.random.normal(jax.random.PRNGKey(2), (2, seq, DIM))
+    flash = PatternAttention(**attn_kw, use_flash=True)
+    grouped = PatternAttention(**attn_kw, use_flash=False)
+    params = flash.init(jax.random.PRNGKey(1), x_big)
+    out_flash = np.asarray(flash.apply(params, x_big))
+    out_grouped = np.asarray(grouped.apply(params, x_big))
+    np.testing.assert_allclose(out_flash, out_grouped, atol=3e-5, rtol=1e-4)

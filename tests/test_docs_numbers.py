@@ -1,0 +1,22 @@
+"""Docs must cite the benchmark record, not a remembered round.
+
+The round-4 advisor found README / DESIGN / PARITY citing three different
+rounds' serving numbers. The fix: docs/BENCH_LATEST.jsonl is the single
+source of truth and tools/sync_bench_docs.py regenerates the marked doc
+blocks from it — this test fails the suite when the blocks drift."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_doc_numbers_match_bench_record():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "sync_bench_docs.py"), "--check"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, (
+        f"docs drifted from docs/BENCH_LATEST.jsonl:\n{proc.stdout}{proc.stderr}"
+    )

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Regenerate the headline perf lines in the docs from the benchmark record.
+
+Single source of truth: ``docs/BENCH_LATEST.jsonl`` — the metric lines a
+``python bench.py`` run prints (refresh it with
+``python bench.py | grep '^{' > docs/BENCH_LATEST.jsonl`` on the TPU box).
+This script rewrites the marked blocks in README.md, PARITY.md and
+docs/DESIGN.md from that record so the prose can never drift from the
+measurement (the round-4 advisor found three documents citing three
+different rounds' numbers). ``tests/test_docs_numbers.py`` asserts the
+blocks match, so a stale doc fails the suite instead of shipping.
+
+    python tools/sync_bench_docs.py          # rewrite the docs
+    python tools/sync_bench_docs.py --check  # exit 1 if any doc is stale
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+RECORD = REPO / "docs" / "BENCH_LATEST.jsonl"
+
+BEGIN = "<!-- bench:generated (tools/sync_bench_docs.py; do not hand-edit) -->"
+END = "<!-- bench:end -->"
+
+
+def load_metrics() -> dict:
+    if not RECORD.exists():
+        sys.exit(
+            f"{RECORD} missing — refresh it on the TPU box with:\n"
+            "  python bench.py | grep '^{' > docs/BENCH_LATEST.jsonl"
+        )
+    metrics = {}
+    for line in RECORD.read_text().splitlines():
+        line = line.strip()
+        if not line or not line.startswith("{"):
+            continue
+        row = json.loads(line)
+        metrics[row["metric"]] = row
+    return metrics
+
+
+def _fmt_pct(x: float) -> str:
+    return f"{100.0 * x:.1f}%"
+
+
+def render_readme(m: dict) -> str:
+    mfu = m["train_mfu_dalle_depth12_dim1024_seq1280_1chip"]
+    gen = m["gen_latency_p50_image1024_tokens_1chip"]
+    gen8 = m["gen_latency_p50_image1024_tokens_1chip_int8"]
+    lines = [
+        f"On one {mfu['device']} chip: **{_fmt_pct(mfu['value'])} MFU** "
+        f"({mfu['vs_baseline']:.2f}x the 45% target; "
+        f"{mfu['step_time_ms']:.0f} ms/step, "
+        f"{mfu['samples_per_sec']:.0f} samples/sec), "
+        f"**{gen['ms_per_token']:.2f} ms/token** bf16 generation and "
+        f"**{gen8['ms_per_token']:.2f} ms/token** with `--int8` weight-only "
+        f"quantized serving."
+    ]
+    tp = sorted(
+        (m[k] for k in m if k.startswith("gen_throughput_tokens_per_sec")),
+        key=lambda r: r["batch"],
+    )
+    if tp:
+        parts = ", ".join(
+            f"{r['value']:,.0f} tok/s at batch {r['batch']} "
+            f"({r['scaling_vs_batch1']:.1f}x batch-1)" for r in tp
+        )
+        lines.append(f"Batched int8 serving: {parts}.")
+    vae = m.get("train_vae_step_time_img128_l3_r2_batch8")
+    clip = m.get("train_clip_step_time_dim512_d6x6_img256_batch16")
+    if vae and clip:
+        lines.append(
+            f"The other trainers: DiscreteVAE {vae['value']:.1f} ms/step "
+            f"({vae['achieved_tflops']:.0f} TF/s, "
+            f"{vae['samples_per_sec']:.0f} samples/sec) and CLIP "
+            f"{clip['value']:.1f} ms/step ({clip['achieved_tflops']:.0f} TF/s) "
+            f"at their reference-default configs in bf16."
+        )
+    return "\n".join(lines)
+
+
+def render_parity(m: dict) -> str:
+    mfu = m["train_mfu_dalle_depth12_dim1024_seq1280_1chip"]
+    gen = m["gen_latency_p50_image1024_tokens_1chip"]
+    gen8 = m["gen_latency_p50_image1024_tokens_1chip_int8"]
+    return (
+        f"  (bf16 and int8 serving). Latest single-chip {mfu['device']}: "
+        f"**{_fmt_pct(mfu['value'])} MFU** (target >=45%), "
+        f"**{gen['value'] / 1e3:.2f} s** p50 for 1024 image tokens "
+        f"({gen['ms_per_token']:.2f} ms/token bf16, "
+        f"**{gen8['ms_per_token']:.2f} ms/token int8**)."
+    )
+
+
+def render_design(m: dict) -> str:
+    gen = m["gen_latency_p50_image1024_tokens_1chip"]
+    gen8 = m["gen_latency_p50_image1024_tokens_1chip_int8"]
+    return (
+        f"Measured on one chip ({gen['device']}): "
+        f"{gen['ms_per_token']:.2f} ms/token bf16, "
+        f"{gen8['ms_per_token']:.2f} ms/token int8."
+    )
+
+
+TARGETS = {
+    REPO / "README.md": render_readme,
+    REPO / "PARITY.md": render_parity,
+    REPO / "docs" / "DESIGN.md": render_design,
+}
+
+
+def sync(check: bool) -> int:
+    metrics = load_metrics()
+    stale = []
+    for path, render in TARGETS.items():
+        text = path.read_text()
+        pattern = re.compile(
+            re.escape(BEGIN) + r"\n.*?" + re.escape(END), re.DOTALL
+        )
+        if not pattern.search(text):
+            print(f"ERROR: {path.name} has no bench block markers", file=sys.stderr)
+            return 2
+        try:
+            block = f"{BEGIN}\n{render(metrics)}\n{END}"
+        except KeyError as e:
+            sys.exit(
+                f"{RECORD.name} is missing metric {e} needed by {path.name} — "
+                "it must come from a FULL `python bench.py` run, not a "
+                "single-section (--patterns/--vae/...) capture"
+            )
+        new = pattern.sub(lambda _m: block, text, count=1)
+        if new != text:
+            if check:
+                stale.append(path.name)
+            else:
+                path.write_text(new)
+                print(f"updated {path.name}")
+    if check and stale:
+        print(
+            f"stale bench numbers in: {', '.join(stale)} — run "
+            "tools/sync_bench_docs.py",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(sync(check="--check" in sys.argv))
