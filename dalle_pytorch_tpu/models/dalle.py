@@ -212,6 +212,35 @@ class DALLE(nn.Module):
             out = divide_max(out)
         return self.to_logits(self.final_norm(out)).astype(jnp.float32)
 
+    def _head_image(self, out: jnp.ndarray) -> jnp.ndarray:
+        """Image-vocab-only head: the ``[ext:]`` column slice of the
+        ``to_logits`` matvec, for decode steps that can only emit image
+        tokens (every post-prefill step of image generation). Streams ~55%
+        fewer head-weight bytes per token than the full head. The slice
+        starts at the 128-aligned column below ``ext`` so the (int8 or bf16)
+        kernel read stays tile-aligned; the few extra text columns are
+        dropped from the result."""
+        if self.stable:
+            out = divide_max(out)
+        normed = self.final_norm(out)
+        if self.is_initializing():
+            self.to_logits(normed[:, :1])  # materialize the head params
+        p = self.variables["params"]["to_logits"]
+        ext = self.num_text_tokens_ext
+        lo = (ext // 128) * 128
+        h = normed.astype(self.dtype)
+        if "kernel_q" in p:
+            # mirror QuantDense: int8 columns widened in-register, then the
+            # per-output-channel scale (ops/layers.py:QuantDense)
+            q = jnp.asarray(p["kernel_q"])[:, lo:]
+            logits = (h @ q.astype(self.dtype)) * jnp.asarray(p["scale"])[
+                lo:
+            ].astype(self.dtype)
+        else:
+            logits = h @ jnp.asarray(p["kernel"], self.dtype)[:, lo:]
+        logits = logits + jnp.asarray(p["bias"])[lo:].astype(self.dtype)
+        return logits[..., ext - lo :].astype(jnp.float32)
+
     # ------------------------------------------------------------- forward
 
     def __call__(
@@ -352,6 +381,7 @@ class DALLE(nn.Module):
         token: jnp.ndarray,
         pos: jnp.ndarray,
         mask: Optional[jnp.ndarray] = None,
+        image_only: bool = False,
     ) -> jnp.ndarray:
         """One KV-cached decode step.
 
@@ -359,6 +389,19 @@ class DALLE(nn.Module):
         text id (bos included) when pos < text_len_internal, otherwise an
         un-offset image token id. Returns (b, total_tokens) logits predicting
         position pos+1. The transformer's cache collections must be mutable.
+        The supplied K/V caches may be narrower than the full sequence (the
+        segmented decode scan sizes them to the generation frontier,
+        models/sampling.py) — every layer sweeps whatever extent it is
+        handed (Attention._decode_attend).
+
+        ``image_only`` (static) asserts pos + 1 is an image position and
+        computes only the image-vocab slice of the head, returning
+        (b, num_image_tokens) logits — exactly the full head's ``[ext:]``
+        slice, since image rows of the logits mask permit the whole image
+        vocab (``logits_mask_np``). Measured on v5e int8 serving this is
+        ~100 us/token: it removes the text-vocab head matvec columns AND
+        the full-vocab (b, 18k) f32 mask/where/slice chain from the serial
+        per-step op sequence.
         """
         b = token.shape[0]
         is_text = pos < self.text_len_internal
@@ -383,6 +426,8 @@ class DALLE(nn.Module):
             x, mask=self._full_key_mask(mask, self.text_len_internal + self.image_seq_len),
             deterministic=True, decode=True,
         )
+        if image_only:
+            return self._head_image(out)[:, 0]
         logits = self._head(out)[:, 0]
         mask_row = jax.lax.dynamic_slice_in_dim(
             jnp.asarray(self.logits_mask_np()), jnp.minimum(pos, self.total_seq_len - 1), 1, axis=0

@@ -216,6 +216,7 @@ class PatternAttention(nn.Module):
                 and self.attn_type == "full"
                 and self.causal
                 and _dk.fused_decode_supported(h, d)
+                and not self._has_windowed_cache()
             ):
                 # OPT-IN fused decode kernel (ops/decode_attention.py):
                 # measured SLOWER than the XLA op chain on v5e (see that
@@ -655,26 +656,45 @@ class PatternAttention(nn.Module):
         cache_index.value = idx + 1
         return out
 
+    def _has_windowed_cache(self) -> bool:
+        """True when a supplied decode cache is narrower than seq_len (the
+        segmented decode scan, models/sampling.py, grows the cache arrays
+        between scan segments so early tokens sweep a smaller buffer)."""
+        if not self.has_variable("cache", "cached_key"):
+            return False
+        ck = self.get_variable("cache", "cached_key")
+        return ck.shape[1] != self.seq_len
+
     def _decode_attend(self, q, k, v, mask, rotary_pos_emb):
-        """Decode against an n-major (b, L, h, d) K/V cache: single-token
+        """Decode against an n-major (b, W, h, d) K/V cache: single-token
         steps or multi-token prefill blocks (n > 1, e.g. the text prompt in
         one parallel pass). Each new token's row of the pattern mask selects
         which cached keys it sees, so attending against the full-length cache
         (zeros beyond the write index, always masked) matches sequential
         decode exactly. The cache keeps positions on the second-major axis so
-        the per-token cache-wide QK^T / AV sweeps scan (L, h*d) rows in the
+        the per-token cache-wide QK^T / AV sweeps scan (W, h*d) rows in the
         projection's natural layout and decode needs no head transposes at
         all. (The sweeps themselves are latency-bound on the serial
         cache-update -> read dependency, not layout-bound: per-token cost
-        measured identical to the (b, h, L, d) variant.)"""
+        measured identical to the (b, h, W, d) variant.)
+
+        The sweep extent W is the SUPPLIED cache's row count, normally
+        seq_len: the segmented decode scan (models/sampling.py) passes
+        caches sized to the generation frontier (guaranteeing idx + n <= W)
+        so early tokens pay O(W) HBM traffic instead of O(seq_len). Rows in
+        [idx + n, W) are zeros under a False pattern-mask column, exactly
+        like the full-length case, so the result is mathematically
+        identical — masked lanes contribute exp(-inf) = 0 either way (~1 ulp
+        summation-order drift where the narrower einsum chunks
+        differently)."""
         b, n, h, d = q.shape
-        L = self.seq_len
 
         cached_key, cached_value, cache_index, is_init = self._decode_caches(
             b, k.dtype
         )
         if is_init:
             return jnp.zeros_like(q)
+        W = cached_key.value.shape[1]
 
         idx = cache_index.value
         if rotary_pos_emb is not None:
@@ -686,12 +706,14 @@ class PatternAttention(nn.Module):
         cached_key.value = jax.lax.dynamic_update_slice_in_dim(cached_key.value, k, idx, axis=1)
         cached_value.value = jax.lax.dynamic_update_slice_in_dim(cached_value.value, v, idx, axis=1)
         cache_index.value = idx + n
+        k_cache = cached_key.value
+        v_cache = cached_value.value
 
         allowed = jax.lax.dynamic_slice_in_dim(
-            jnp.asarray(self.pattern_mask()), idx, n, axis=0
-        )[None, None]  # (1, 1, n, L)
+            jnp.asarray(self.pattern_mask())[:, :W], idx, n, axis=0
+        )[None, None]  # (1, 1, n, W)
         if mask is not None:
-            allowed = allowed & mask[:, None, None, :]
+            allowed = allowed & mask[:, None, None, :W]
 
         if n == 1 and d < 128 and 128 % d == 0 and h % (128 // d) == 0:
             # lane-packed single-token sweeps: dim_head < 128 half-fills
@@ -703,8 +725,8 @@ class PatternAttention(nn.Module):
             P_ = 128 // d
             G = h // P_
             eye = jnp.eye(P_, dtype=q.dtype)
-            K2 = cached_key.value.reshape(b, L, G, P_ * d)
-            V2 = cached_value.value.reshape(b, L, G, P_ * d)
+            K2 = k_cache.reshape(b, W, G, P_ * d)
+            V2 = v_cache.reshape(b, W, G, P_ * d)
             qr = q.reshape(b, G, P_, d)
             qblk = jnp.einsum("bgpd,pq->bgpdq", qr, eye).reshape(b, G, P_ * d, P_)
             s = jnp.einsum(
@@ -722,13 +744,13 @@ class PatternAttention(nn.Module):
             return out.reshape(b, 1, h, d)
 
         scores = jnp.einsum(
-            "bnhd,blhd->bhnl", q, cached_key.value,
+            "bnhd,blhd->bhnl", q, k_cache,
             preferred_element_type=jnp.float32,
         )
         scores = jnp.where(allowed, scores, NEG_INF)
         attn = _softmax(scores, self.stable)
         return jnp.einsum(
-            "bhnl,blhd->bnhd", attn.astype(cached_value.value.dtype), cached_value.value
+            "bhnl,blhd->bnhd", attn.astype(v_cache.dtype), v_cache
         )
 
     # Decode cost accounting (int8 serving, v5e-1, measured by trace —
@@ -755,3 +777,19 @@ class PatternAttention(nn.Module):
     # one of the 1024 steps. The caches therefore stay bf16; int8 serving
     # quantizes what decode is actually bound on — the weight matrices and
     # embedding tables (utils/quantize.py).
+    #
+    # Round-5 serial-chain attack (measured, v5e-1, 2026-07): the "head +
+    # sampling the rest" slice of the accounting above was mostly NOT the
+    # head matvec — it was the per-step (b, 18k)-wide f32 op chain around
+    # it (logits-mask dynamic-slice + where, f32 cast, the [ext:] sampling
+    # slice). The image-only head (models/dalle.py:_head_image) computes
+    # just the image-vocab head columns and drops that chain entirely:
+    # int8 batch-1 0.779 -> 0.686 ms/token. Two windowed-sweep designs were
+    # then measured for the O(frontier)-instead-of-O(L) cache sweep idea:
+    # (a) static sliced VIEWS of the full cache inside the step — XLA
+    # materializes the slice as a per-step copy, +0.11 ms/token, REJECTED;
+    # (b) frontier-sized cache ARRAYS grown between scan segments
+    # (models/sampling.py:resize_kv) — batch-1 neutral-to-slightly-negative
+    # (latency-bound), batch >= 8 wins 12-13% tokens/sec (sweep traffic
+    # scales with batch). Hence the batch-adaptive segmentation default in
+    # decode_tokens.

@@ -271,6 +271,62 @@ class TestDALLE:
                 err_msg=f"cache mismatch at {jax.tree_util.keystr(p1)} ({kw})",
             )
 
+    @pytest.mark.parametrize("kw", [dict(), dict(attn_types=("conv_like", "axial_row"))])
+    def test_windowed_decode_and_image_head_match_full(self, kw):
+        """A decode step against frontier-sized (truncated) K/V caches with
+        the image-only sliced head must equal the full-cache, full-head
+        step: truncated-away rows are masked (exp(-inf) = 0 contributions
+        either way, ops/attention.py:_decode_attend) and the sliced head
+        computes the exact same output columns (models/dalle.py:_head_image).
+        Tolerance covers summation-order drift only (the narrower einsum
+        chunks its reduction differently; ~1 ulp observed on CPU)."""
+        from dalle_pytorch_tpu.models.sampling import decode_tokens  # noqa: F401
+
+        dalle = small_dalle(**kw)
+        text, image = dalle_inputs(dalle, b=2)
+        params = dalle.init(jax.random.key(0), text, image)["params"]
+        internal = dalle.remap_text(text)
+        T = dalle.text_len_internal
+
+        cache = init_decode_cache(dalle, params, batch_size=2)
+        _, mutated = dalle.apply(
+            {"params": params, "cache": cache},
+            internal,
+            method=DALLE.prefill_step,
+            mutable=["cache"],
+        )
+        cache = mutated["cache"]
+        tok = image[:, 0]
+        pos = jnp.array(T, jnp.int32)
+
+        full, _ = dalle.apply(
+            {"params": params, "cache": cache}, tok, pos,
+            method=DALLE.decode_step, mutable=["cache"],
+        )
+        ext = dalle.num_text_tokens_ext
+
+        def truncate_kv(cache, W):
+            def fn(path, x):
+                if getattr(path[-1], "key", None) in ("cached_key", "cached_value"):
+                    return x[:, :W]
+                return x
+
+            return jax.tree_util.tree_map_with_path(fn, cache)
+
+        for window in (T + 1, T + 3, None):
+            small = cache if window is None else truncate_kv(cache, window)
+            sliced, _ = dalle.apply(
+                {"params": params, "cache": small}, tok, pos,
+                image_only=True,
+                method=DALLE.decode_step, mutable=["cache"],
+            )
+            assert sliced.shape == (2, dalle.num_image_tokens)
+            np.testing.assert_allclose(
+                np.asarray(sliced), np.asarray(full[:, ext:]),
+                atol=1e-5, rtol=1e-5,
+                err_msg=f"window={window} ({kw})",
+            )
+
 
 # ------------------------------------------------------------------- CLIP
 
