@@ -177,18 +177,22 @@ def decode_tokens(
     # image generation. Per-segment unrolling amortizes loop overhead in
     # the bandwidth-bound decode (measured ~2% p50 latency on v5e at
     # unroll=4).
-    # Batch-adaptive segmentation (measured, v5e-1 int8 flagship, 2026-07):
-    # K/V sweep traffic scales with batch while the per-segment overhead
+    # Adaptive segmentation (measured, v5e-1 flagship, 2026-07): K/V sweep
+    # traffic scales with batch while the per-segment overhead
     # (scan-boundary cache pads, extra program) is ~fixed, so frontier-sized
-    # caches win exactly when sweeps dominate. batch 1: seg 0 = 0.686
-    # ms/token vs 0.704-0.709 segmented (single-stream decode is
-    # latency-bound; shorter sweeps don't pay for the boundaries). batch 8:
-    # seg 512 = 5136 tok/s vs 4569 unsegmented (+12%); batch 32: 6381 vs
-    # 5644 (+13%). seg 256 / 1024 measured worse than 512 at batch 8
-    # (4985 / 4921).
+    # caches win whenever sweeps are a large share of the step. Measured
+    # ms/token (batch 1) and tokens/sec (batched):
+    #   int8 b1: seg 0 = 0.686 vs 0.704-0.709 segmented  -> seg 0
+    #   bf16 b1: seg 512 = 0.917, seg 256 = 0.929, seg 0 = 1.219 -> seg 512
+    #   int8 b8: seg 512 = 5136 vs 4569 unsegmented (+12%); seg 256/1024
+    #            worse (4985/4921); int8 b32: 6381 vs 5644 (+13%) -> seg 512
+    # Only quantized single-stream decode prefers no segmentation (int8
+    # halves the weight stream, leaving the step latency-bound on the
+    # serial op chain where the boundary programs only add overhead).
     seg = window_seg if window_seg is not None else DECODE_WINDOW_SEG
     if seg is None:
-        seg = 0 if b == 1 else 512
+        seg = 0 if (b == 1 and getattr(dalle, "serve_quant", False)) else 512
+    assert seg >= 0, f"window_seg must be >= 0 (0 disables segmentation), got {seg}"
     n_cache = dalle.text_len_internal + dalle.image_seq_len
     carry = (cache, tokens, key)
     s = start

@@ -280,8 +280,6 @@ class TestDALLE:
         computes the exact same output columns (models/dalle.py:_head_image).
         Tolerance covers summation-order drift only (the narrower einsum
         chunks its reduction differently; ~1 ulp observed on CPU)."""
-        from dalle_pytorch_tpu.models.sampling import decode_tokens  # noqa: F401
-
         dalle = small_dalle(**kw)
         text, image = dalle_inputs(dalle, b=2)
         params = dalle.init(jax.random.key(0), text, image)["params"]
