@@ -29,6 +29,10 @@ from .dalle import DALLE, top_k_filter
 # caches every k positions.
 DECODE_WINDOW_SEG = None
 
+# Scan-body unroll for the decode loop (see the segmented-scan comment in
+# decode_tokens).
+DECODE_UNROLL = 4
+
 
 def init_decode_cache(dalle: DALLE, params, batch_size: int):
     """Materialize the transformer's KV/shift caches for a batch."""
@@ -155,8 +159,9 @@ def decode_tokens(
         (ops/attention.py:_decode_attend), so a smaller ARRAY — not a
         sliced view, which XLA materializes as a per-step copy (measured
         +0.11 ms/token, v5e int8) — is what makes a short window cheap.
-        Only the K/V caches resize; the token-shift / gMLP-gate histories
-        index by absolute position and keep their full extent."""
+        Only the K/V caches resize: the token-shift history is already a
+        fixed-size ring (ops/layers.py:PreShiftToken) and the gMLP gate
+        history indexes by absolute position at full extent."""
         def fn(path, x):
             if getattr(path[-1], "key", None) in ("cached_key", "cached_value"):
                 if x.shape[1] > W:
@@ -202,7 +207,7 @@ def decode_tokens(
             W = min(n_cache, -(-e // 128) * 128)
             carry = (resize_kv(carry[0], W), carry[1], carry[2])
         carry, _ = jax.lax.scan(
-            step, carry, jnp.arange(s, e, dtype=jnp.int32), unroll=4,
+            step, carry, jnp.arange(s, e, dtype=jnp.int32), unroll=DECODE_UNROLL,
         )
         s = e
     _, tokens, _ = carry

@@ -26,12 +26,13 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import functools
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import flax.linen as nn
-
-import functools
 
 from . import masks as masks_lib
 from .flash_attention import (
@@ -595,14 +596,39 @@ class PatternAttention(nn.Module):
     def _decode_caches(self, b, dtype):
         """The decode cache variables — ONE declaration shared by the fused
         and unfused paths, so prefill (unfused) composes with fused
-        per-token steps on bit-identical caches."""
+        per-token steps on bit-identical caches.
+
+        The cache ARRAY SHAPE is a measured, batch-conditional choice
+        (v5e-1 int8 flagship, 2026-07). 4-D (b, L, h, d) compiles to a
+        positions-minor layout ({1,3,2,0}) whose per-step one-row
+        dynamic-update-slice scatters across the whole buffer —
+        trace-measured at 43% of the batch-8 decode program. Folding the
+        head axis away, FLAT (b, L, h*d), flips the layout to
+        channels-on-lanes and fixes the update at mid batch (batch 8:
+        4,870 -> 6,705 tok/s) — but the SAME flat shape re-tips XLA's
+        layout choice the other way at batch 1 (0.660 -> 0.747 ms/token
+        int8, 0.900 -> 0.988 bf16) and batch 32 (6,075 -> 4,158 tok/s),
+        and an optimization_barrier on the cache reads changes none of it.
+        Batches 4 and 16 also prefer 4-D (3,829 vs 2,893 and 5,781 vs
+        4,032) — the flat win is a batch-8 phenomenon on this compiler,
+        not a trend. Policy: flat exactly where it is proven (b == 8),
+        4-D otherwise; every sweep/update site handles either rank, and
+        DALLE_TPU_FLAT_KV=0/1 overrides for re-measurement at other
+        shapes/compiler versions."""
         h, d, L = self.heads, self.dim_head, self.seq_len
+        force = os.environ.get("DALLE_TPU_FLAT_KV")
+        if force not in (None, "", "0", "1"):
+            raise ValueError(
+                f"DALLE_TPU_FLAT_KV must be '0' or '1', got {force!r}"
+            )
+        flat = (force == "1") if force in ("0", "1") else b == 8
+        kv_shape = (b, L, h * d) if flat else (b, L, h, d)
         is_init = not self.has_variable("cache", "cached_key")
         cached_key = self.variable(
-            "cache", "cached_key", jnp.zeros, (b, L, h, d), dtype
+            "cache", "cached_key", jnp.zeros, kv_shape, dtype
         )
         cached_value = self.variable(
-            "cache", "cached_value", jnp.zeros, (b, L, h, d), dtype
+            "cache", "cached_value", jnp.zeros, kv_shape, dtype
         )
         cache_index = self.variable(
             "cache", "cache_index", lambda: jnp.array(0, dtype=jnp.int32)
@@ -638,6 +664,7 @@ class PatternAttention(nn.Module):
         rot_p = jnp.asarray(_rotate_half_matrix(d), qkv.dtype)
         key_mask = None if mask is None else mask[..., None].astype(jnp.int32)
 
+        flat_kv = cached_key.value.ndim == 3
         out, k_row, v_row = fused_decode_attention(
             qkv,
             cached_key.value.reshape(b, L, h * d),
@@ -647,11 +674,12 @@ class PatternAttention(nn.Module):
             interpret=jax.devices()[0].platform != "tpu",
         )
         upd = jax.lax.dynamic_update_slice_in_dim
+        row_shape = (b, 1, h * d) if flat_kv else (b, 1, h, d)
         cached_key.value = upd(
-            cached_key.value, k_row.reshape(b, 1, h, d), idx, axis=1
+            cached_key.value, k_row.reshape(row_shape), idx, axis=1
         )
         cached_value.value = upd(
-            cached_value.value, v_row.reshape(b, 1, h, d), idx, axis=1
+            cached_value.value, v_row.reshape(row_shape), idx, axis=1
         )
         cache_index.value = idx + 1
         return out
@@ -703,8 +731,13 @@ class PatternAttention(nn.Module):
             q, k, v = (apply_rotary_emb(rows, t) for t in (q, k, v))
         q = q * (d**-0.5)
 
-        cached_key.value = jax.lax.dynamic_update_slice_in_dim(cached_key.value, k, idx, axis=1)
-        cached_value.value = jax.lax.dynamic_update_slice_in_dim(cached_value.value, v, idx, axis=1)
+        flat_kv = cached_key.value.ndim == 3
+        cached_key.value = jax.lax.dynamic_update_slice_in_dim(
+            cached_key.value, k.reshape(b, n, h * d) if flat_kv else k, idx, axis=1
+        )
+        cached_value.value = jax.lax.dynamic_update_slice_in_dim(
+            cached_value.value, v.reshape(b, n, h * d) if flat_kv else v, idx, axis=1
+        )
         cache_index.value = idx + n
         k_cache = cached_key.value
         v_cache = cached_value.value
@@ -744,13 +777,14 @@ class PatternAttention(nn.Module):
             return out.reshape(b, 1, h, d)
 
         scores = jnp.einsum(
-            "bnhd,blhd->bhnl", q, k_cache,
+            "bnhd,blhd->bhnl", q, k_cache.reshape(b, W, h, d),
             preferred_element_type=jnp.float32,
         )
         scores = jnp.where(allowed, scores, NEG_INF)
         attn = _softmax(scores, self.stable)
         return jnp.einsum(
-            "bhnl,blhd->bnhd", attn.astype(v_cache.dtype), v_cache
+            "bhnl,blhd->bnhd", attn.astype(v_cache.dtype),
+            v_cache.reshape(b, W, h, d),
         )
 
     # Decode cost accounting (int8 serving, v5e-1, measured by trace —
@@ -793,3 +827,14 @@ class PatternAttention(nn.Module):
     # (latency-bound), batch >= 8 wins 12-13% tokens/sec (sweep traffic
     # scales with batch). Hence the batch-adaptive segmentation default in
     # decode_tokens.
+    #
+    # Dynamic-update-slice traffic (trace-found, v5e-1, 2026-07): a batch-8
+    # trace showed 43% of the decode program in DUS. Two separate causes,
+    # two fixes: (1) the token-shift histories were full-sequence
+    # (b, 1281, dim) buffers updated every step — but the shift only looks
+    # back image_size positions, so they are now (image_size+1)-row rings
+    # with static slice indices (ops/layers.py:PreShiftToken), worth ~3-4%
+    # at batch 1 and ~40x less shift-cache memory; (2) the K/V caches'
+    # 4-D shape compiled to a positions-minor layout whose one-row update
+    # rewrites the whole buffer — see the measured batch-conditional
+    # flat-vs-4-D policy in _decode_caches (batch 8: +38% tokens/sec).

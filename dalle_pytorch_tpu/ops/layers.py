@@ -281,15 +281,26 @@ class PreShiftToken(nn.Module):
             return self.fn(x, **inner_kwargs)
 
         b, n, d = x.shape
-        total = text_len + img_seq_len
+        # The shift only ever looks back image_size positions (prev token and
+        # row-above), so the history is a RING of the last R = image_size + 1
+        # raw inputs, newest last: before consuming position pos, row j holds
+        # position pos - R + j. A full-sequence (b, total, d) history was the
+        # original design; its per-step updates were part of a
+        # dynamic-update-slice category trace-measured at 43% of the
+        # batch-8 decode program (shared with the K/V cache updates — see
+        # ops/attention.py's cost notes for the split and the KV-side fix).
+        # The ring is ~40x smaller, uses only STATIC slice indices, and is
+        # bit-identical — every read the ring
+        # cannot serve (pos 0's "previous", out-of-grid row-above) is already
+        # masked to zero inside shift_tokens_decode / the prefill rule.
+        R = self.image_size + 1
         is_init = not self.has_variable("cache", "shift_hist")
-        hist = self.variable("cache", "shift_hist", jnp.zeros, (b, total, d), x.dtype)
+        hist = self.variable("cache", "shift_hist", jnp.zeros, (b, R, d), x.dtype)
         pos_var = self.variable("cache", "shift_index", lambda: jnp.array(0, jnp.int32))
         if is_init:
             return self.fn(x, **inner_kwargs)
 
         pos = pos_var.value
-        hist.value = jax.lax.dynamic_update_slice(hist.value, x, (0, pos, 0))
         if n > 1:
             # prefill: a block of n text positions (n <= text_len and the
             # whole block must lie inside the text part — callers prefill the
@@ -298,25 +309,21 @@ class PreShiftToken(nn.Module):
             # token — block-internal rows shift from the block itself, row 0
             # from the history (zero when the block starts the sequence).
             assert n <= text_len, "prefill blocks must stay within the text part"
-            prev_first = jnp.where(
-                pos > 0,
-                jax.lax.dynamic_slice(
-                    hist.value, (0, jnp.maximum(pos - 1, 0), 0), (b, 1, d)
-                ),
-                0.0,
-            )
+            prev_first = jnp.where(pos > 0, hist.value[:, -1:], 0.0)
             prev_block = jnp.concatenate((prev_first, x[:, :-1]), axis=1)
             pos_var.value = pos + n
+            hist.value = (
+                x[:, n - R :]
+                if n >= R
+                else jnp.concatenate((hist.value[:, n:], x), axis=1)
+            )
             half = d // 2
             x = jnp.concatenate((prev_block[..., :half], x[..., half:]), axis=-1)
         else:
-            prev = jax.lax.dynamic_slice(
-                hist.value, (0, jnp.maximum(pos - 1, 0), 0), (b, 1, d)
-            )
-            row_above = jax.lax.dynamic_slice(
-                hist.value, (0, jnp.maximum(pos - self.image_size, 0), 0), (b, 1, d)
-            )
+            prev = hist.value[:, R - 1 :]  # position pos - 1
+            row_above = hist.value[:, 1:2]  # position pos - image_size
             pos_var.value = pos + 1
+            hist.value = jnp.concatenate((hist.value[:, 1:], x), axis=1)
             x = shift_tokens_decode(x, pos, prev, row_above, text_len, self.image_size)
         return self.fn(x, **inner_kwargs)
 

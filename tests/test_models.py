@@ -325,6 +325,22 @@ class TestDALLE:
                 err_msg=f"window={window} ({kw})",
             )
 
+    def test_flat_kv_cache_format_matches_4d(self, monkeypatch):
+        """The flat (b, L, h*d) K/V cache format (the measured batch-8
+        serving layout, ops/attention.py:_decode_caches) must sample the
+        exact same tokens as the default 4-D format — the rank only changes
+        the array shape XLA lays out, never the arithmetic."""
+        dalle = small_dalle()
+        text, image = dalle_inputs(dalle, b=2)
+        params = dalle.init(jax.random.key(0), text, image)["params"]
+
+        monkeypatch.setenv("DALLE_TPU_FLAT_KV", "0")
+        toks_4d = generate_image_tokens(dalle, params, text, jax.random.key(7))
+        jax.clear_caches()  # cache shapes differ; force a fresh trace
+        monkeypatch.setenv("DALLE_TPU_FLAT_KV", "1")
+        toks_flat = generate_image_tokens(dalle, params, text, jax.random.key(7))
+        np.testing.assert_array_equal(np.asarray(toks_4d), np.asarray(toks_flat))
+
 
 # ------------------------------------------------------------------- CLIP
 
