@@ -611,10 +611,12 @@ class PatternAttention(nn.Module):
         and an optimization_barrier on the cache reads changes none of it.
         Batches 4 and 16 also prefer 4-D (3,829 vs 2,893 and 5,781 vs
         4,032) — the flat win is a batch-8 phenomenon on this compiler,
-        not a trend. Policy: flat exactly where it is proven (b == 8),
-        4-D otherwise; every sweep/update site handles either rank, and
-        DALLE_TPU_FLAT_KV=0/1 overrides for re-measurement at other
-        shapes/compiler versions."""
+        not a trend; at batch 32 flat loses at every segment size tried
+        (seg 0/512/1024 all ~3.3-4.2k tok/s vs ~6.1-6.3k 4-D, so the 4-D
+        DUS tax there is the lesser evil and bounded). Policy: flat
+        exactly where it is proven (b == 8), 4-D otherwise; every
+        sweep/update site handles either rank, and DALLE_TPU_FLAT_KV=0/1
+        overrides for re-measurement at other shapes/compiler versions."""
         h, d, L = self.heads, self.dim_head, self.seq_len
         force = os.environ.get("DALLE_TPU_FLAT_KV")
         if force not in (None, "", "0", "1"):
