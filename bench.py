@@ -36,6 +36,16 @@ PEAK_FLOPS = {
     "cpu": 5e11,
 }
 
+# HBM bandwidth per chip, bytes/sec (v5e = 819 GB/s; v4 = 1228; v6e = 1640)
+PEAK_HBM_BPS = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "cpu": 50e9,
+}
+
 DEPTH, DIM, HEADS, DIM_HEAD = 12, 1024, 16, 64
 TEXT_SEQ, IMAGE_FMAP = 256, 32
 NUM_TEXT, NUM_IMAGE = 10000, 8192
@@ -48,6 +58,192 @@ def peak_flops() -> float:
         if k.lower() in kind.lower():
             return v
     return 197e12
+
+
+def peak_hbm_bps() -> float:
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_HBM_BPS.items():
+        if k.lower() in kind.lower():
+            return v
+    return 819e9
+
+
+def decode_roofline_tokens_per_sec(
+    batch: int,
+    int8: bool = True,
+    depth: int = DEPTH,
+    fmap: int = IMAGE_FMAP,
+    frontier_avg: float | None = None,
+) -> float:
+    """Named bound: **kv_sweep_weight_stream_hbm_roofline** — the decode
+    tokens/sec ceiling from HBM bytes alone, derived here so the batch
+    sweep's records carry a bound instead of an asserted story.
+
+    Per decode step the chip must stream, once per STEP (amortized across
+    the batch):
+      - the transformer matmul weights: depth * 16 * dim^2 params
+        (qkv 3d^2 + out d^2 + GEGLU 12d^2), 1 byte/param int8, 2 bf16;
+      - the image-vocab head slice: dim * num_image_tokens columns
+        (models/dalle.py:_head_image; embeddings are row gathers,
+        negligible);
+    and, once per SEQUENCE (scales with batch):
+      - the K + V cache sweep: 2 * depth * frontier * heads * dim_head
+        rows of bf16 (2 bytes) — ``frontier_avg`` defaults to the
+        segmented scan's average window, (text_len + L) / 2 rounded to the
+        128-row segment grid (models/sampling.py:resize_kv).
+
+    tok/s(batch) = batch / (step_bytes / HBM_bytes_per_sec). The bound is
+    MONOTONE in batch by construction — the weight stream amortizes while
+    sweeps scale linearly, saturating at the sweep asymptote
+    HBM / (2 * depth * frontier * h * d * 2) tokens/sec — so any measured
+    tokens/sec DECLINE with batch (batch 32's 6,050 vs batch 8's 6,832,
+    BENCH_r05) is a layout/update artifact, not bandwidth: exactly the
+    DUS rewrite cost the paged cache removes structurally. Compute (the
+    lane-packed sweeps' MXU work) and the serial op chain sit below this
+    roofline at every batch here, so bytes are the binding resource.
+    ``depth``/``fmap`` must be the BENCHED model's (the CPU sweep runs a
+    reduced config; a full-size bound next to a reduced measurement would
+    make the attribution story wrong)."""
+    n = TEXT_SEQ + fmap**2
+    if frontier_avg is None:
+        # average ceil-to-128 cache window over the image-token scan
+        t = TEXT_SEQ + 1
+        frontier_avg = (-(-t // 128) * 128 + -(-n // 128) * 128) / 2
+    wbytes = 1 if int8 else 2
+    weight_bytes = depth * 16 * DIM * DIM * wbytes + DIM * NUM_IMAGE * wbytes
+    sweep_bytes = 2 * depth * frontier_avg * HEADS * DIM_HEAD * 2  # bf16 K+V
+    step_bytes = weight_bytes + batch * sweep_bytes
+    return batch / (step_bytes / peak_hbm_bps())
+
+
+def bench_decode_sweep(on_cpu: bool, batch_sizes=(1, 8, 16, 32, 64),
+                       formats=("4d", "flat", "paged"), int8: bool = True):
+    """Decode throughput sweep over batch x cache format — the measurement
+    the layout policy (ops/kv_policy.py) stands on. Each record carries the
+    derived HBM roofline (``decode_roofline_tokens_per_sec`` above) under
+    ``bound_name`` so a non-monotone measured curve is immediately
+    attributable: the bound is monotone in batch, so a decline is a
+    layout/update artifact of that format, not bandwidth."""
+    from dalle_pytorch_tpu.models.sampling import generate_image_tokens
+    from dalle_pytorch_tpu.ops import kv_policy
+
+    if on_cpu:
+        batch_sizes = (1, 2)
+    dalle, params, depth, fmap = _serving_model(on_cpu, int8)
+    rng = np.random.RandomState(0)
+
+    results = []
+    prev_paged_tps = None
+    for b in batch_sizes:
+        text = jnp.asarray(
+            rng.randint(1, NUM_TEXT, size=(b, TEXT_SEQ)), jnp.int32
+        )
+        policy_fmt = kv_policy.choose_cache_format(b)
+        for fmt in formats:
+            def gen(key, fmt=fmt):
+                return generate_image_tokens(
+                    dalle, params, text, key, cache_format=fmt
+                )
+
+            np.asarray(gen(jax.random.key(0)))  # compile
+            times = []
+            for i in range(2 if on_cpu else 3):
+                t0 = time.perf_counter()
+                np.asarray(gen(jax.random.key(i)))
+                times.append(time.perf_counter() - t0)
+            p50 = float(np.percentile(times, 50))
+            tps = b * fmap * fmap / p50
+            rec = {
+                "metric": f"decode_sweep_tokens_per_sec_batch{b}_{fmt}"
+                          + ("_int8" if int8 else ""),
+                "value": round(tps, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": None,
+                "batch": b,
+                "cache_format": fmt,
+                "policy_default_format": policy_fmt,
+                "page_size": kv_policy.page_size() if fmt == "paged" else None,
+                "batch_latency_ms": round(p50 * 1e3, 1),
+                "bound_name": "kv_sweep_weight_stream_hbm_roofline",
+                "roofline_tokens_per_sec": round(
+                    decode_roofline_tokens_per_sec(
+                        b, int8=int8, depth=depth, fmap=fmap
+                    ), 1
+                ),
+                "roofline_note": "derived in bench.py:decode_roofline_tokens_"
+                                 "per_sec; monotone in batch by construction",
+                "device": jax.devices()[0].device_kind,
+            }
+            if fmt == "paged":
+                rec["monotone_vs_prev_batch"] = (
+                    None if prev_paged_tps is None else bool(tps >= prev_paged_tps)
+                )
+                prev_paged_tps = tps
+            results.append(rec)
+    return results
+
+
+def bench_continuous_batching(on_cpu: bool, int8: bool = True):
+    """Ragged-offsets decode microbench: one paged-cache step serves a batch
+    whose sequences sit at DIFFERENT decode positions (continuous batching —
+    requests joining mid-flight instead of waiting for the batch to drain).
+    Measures steady-state tokens/sec of the jitted vector-position
+    ``decode_step``; cache contents are synthetic (cost is what's measured —
+    correctness of the ragged step is pinned bit-exact against per-sequence
+    decode in tests/test_paged_kv.py)."""
+    from dalle_pytorch_tpu.models import DALLE
+    from dalle_pytorch_tpu.models.sampling import (
+        init_decode_cache, set_decode_offsets,
+    )
+
+    b = 4 if on_cpu else 8
+    n_steps = 8 if on_cpu else 128
+    dalle, params, depth, fmap = _serving_model(on_cpu, int8)
+
+    cache = init_decode_cache(dalle, params, b, cache_format="paged")
+    T = dalle.text_len_internal
+    # spread the batch across the image-token range — each sequence at its
+    # own frontier, the shape a continuous-batching serving loop sees
+    offsets = T + (np.arange(b) * dalle.image_seq_len) // b
+    cache = set_decode_offsets(cache, offsets)
+    pos0 = jnp.asarray(offsets, jnp.int32)
+
+    # all n_steps inside ONE jitted scan: a per-step dispatch would swamp
+    # the ms-scale step on remote-attached devices (see _scan_step_time)
+    @jax.jit
+    def run(cache, pos, tok):
+        def body(carry, _):
+            cache, pos, tok = carry
+            logits, mutated = dalle.apply(
+                {"params": params, "cache": cache}, tok, pos,
+                image_only=True, method=DALLE.decode_step, mutable=["cache"],
+            )
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (mutated["cache"], pos + 1, tok), None
+
+        (cache, pos, tok), _ = jax.lax.scan(
+            body, (cache, pos, tok), None, length=n_steps
+        )
+        return tok
+
+    tok = jnp.zeros((b,), jnp.int32)
+    np.asarray(run(cache, pos0, tok))  # compile + warm
+    t0 = time.perf_counter()
+    np.asarray(run(cache, pos0, tok))
+    dt = time.perf_counter() - t0
+    tps = b * n_steps / dt
+    return {
+        "metric": "decode_continuous_batching_tokens_per_sec_batch"
+                  f"{b}" + ("_int8" if int8 else ""),
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "batch": b,
+        "cache_format": "paged",
+        "ragged_offsets": [int(o) for o in offsets],
+        "ms_per_step": round(dt * 1e3 / n_steps, 3),
+        "device": jax.devices()[0].device_kind,
+    }
 
 
 def model_flops_per_step(batch: int, depth: int = DEPTH) -> float:
@@ -321,6 +517,32 @@ def bench_sparse_patterns(on_cpu: bool):
     return results
 
 
+def _serving_model(on_cpu: bool, int8: bool):
+    """The flagship serving model (reduced depth/fmap on CPU), initialized
+    and pushed through ``prepare_for_serving`` — ONE definition for every
+    decode bench section (latency, throughput, sweep, continuous batching)
+    so they cannot drift onto different models. Returns
+    (dalle, params, depth, fmap)."""
+    from dalle_pytorch_tpu.models import DALLE
+    from dalle_pytorch_tpu.utils.quantize import prepare_for_serving
+
+    depth = 2 if on_cpu else DEPTH
+    fmap = 8 if on_cpu else IMAGE_FMAP
+    dalle = DALLE(
+        dim=DIM, depth=depth, num_text_tokens=NUM_TEXT, text_seq_len=TEXT_SEQ,
+        num_image_tokens=NUM_IMAGE, image_fmap_size=fmap,
+        heads=HEADS, dim_head=DIM_HEAD, attn_types=("full",),
+        dtype=jnp.bfloat16,
+    )
+    rng = np.random.RandomState(0)
+    text1 = jnp.asarray(rng.randint(1, NUM_TEXT, size=(1, TEXT_SEQ)), jnp.int32)
+    params = jax.jit(dalle.init)(
+        jax.random.key(0), text1, jnp.zeros((1, fmap * fmap), jnp.int32)
+    )["params"]
+    dalle, params = prepare_for_serving(dalle, params, int8=int8)
+    return dalle, params, depth, fmap
+
+
 def bench_gen_throughput(on_cpu: bool, batch_sizes=(8, 32), int8: bool = True,
                          base_ms_per_token: float | None = None):
     """Batched serving throughput (tokens/sec): decode is weight-streaming
@@ -338,35 +560,35 @@ def bench_gen_throughput(on_cpu: bool, batch_sizes=(8, 32), int8: bool = True,
     scaling. Frontier-sized caches (models/sampling.py) moved batch 8 from
     4,569 to ~5,000 tok/s; the residual gap to the HBM roofline is the
     half-filled-lane sweep inefficiency recorded in ops/attention.py."""
-    from dalle_pytorch_tpu.models import DALLE
     from dalle_pytorch_tpu.models.sampling import generate_image_tokens
-    from dalle_pytorch_tpu.utils.quantize import prepare_for_serving
 
-    depth = 2 if on_cpu else DEPTH
-    fmap = 8 if on_cpu else IMAGE_FMAP
     if on_cpu:
         batch_sizes = (2,)
-    dalle = DALLE(
-        dim=DIM, depth=depth, num_text_tokens=NUM_TEXT, text_seq_len=TEXT_SEQ,
-        num_image_tokens=NUM_IMAGE, image_fmap_size=fmap,
-        heads=HEADS, dim_head=DIM_HEAD, attn_types=("full",),
-        dtype=jnp.bfloat16,
-    )
+    dalle, params, _, fmap = _serving_model(on_cpu, int8)
     rng = np.random.RandomState(0)
-    text1 = jnp.asarray(rng.randint(1, NUM_TEXT, size=(1, TEXT_SEQ)), jnp.int32)
-    params = jax.jit(dalle.init)(
-        jax.random.key(0), text1, jnp.zeros((1, fmap * fmap), jnp.int32)
-    )["params"]
-    dalle, params = prepare_for_serving(dalle, params, int8=int8)
+
+    from dalle_pytorch_tpu.ops import kv_policy
 
     results = []
     # the batch-1 leg only exists to anchor scaling_vs_batch1 — reuse the
     # latency bench's p50 when the caller already measured it (the full
-    # suite), re-measure only in selective --throughput mode
+    # suite), re-measure only in selective --throughput mode. Explicit
+    # None-test (not truthiness): a degenerate 0.0 anchor must surface as
+    # a division error, never silently re-measure under a different
+    # methodology.
     base_tps = (
         None if base_ms_per_token is None else 1e3 / base_ms_per_token
     )
-    batches = tuple(batch_sizes) if base_tps else (1,) + tuple(batch_sizes)
+    # provenance of the scaling anchor, carried in every record: the reused
+    # anchor is bench_generation's 5-rep p50, the in-sweep one this loop's
+    # 2-3-rep p50 — same model/config, different rep counts
+    anchor = (
+        "bench_generation_p50_5rep" if base_ms_per_token is not None
+        else "in_sweep_p50_3rep"
+    )
+    batches = (
+        tuple(batch_sizes) if base_tps is not None else (1,) + tuple(batch_sizes)
+    )
     for b in batches:
         text = jnp.asarray(
             rng.randint(1, NUM_TEXT, size=(b, TEXT_SEQ)), jnp.int32
@@ -393,7 +615,9 @@ def bench_gen_throughput(on_cpu: bool, batch_sizes=(8, 32), int8: bool = True,
             "unit": "tokens/sec",
             "vs_baseline": None,
             "scaling_vs_batch1": round(tps / base_tps, 2),
+            "batch1_anchor": anchor,
             "batch": b,
+            "cache_format": kv_policy.choose_cache_format(b),
             "tokens_per_image": int(fmap * fmap),
             "batch_latency_ms": round(p50 * 1e3, 1),
             "amortized_ms_per_image": round(p50 * 1e3 / b, 1),
@@ -552,27 +776,13 @@ def bench_generation(on_cpu: bool, int8: bool = False):
     """p50 single-chip autoregressive generation latency: scan-decode the
     full 1024 image tokens (BASELINE.md metric row 3). ``int8`` serves the
     same model through the weight-only-quantized path (utils/quantize.py)."""
-    from dalle_pytorch_tpu.models import DALLE
     from dalle_pytorch_tpu.models.sampling import generate_image_tokens
 
-    depth = 2 if on_cpu else DEPTH
-    fmap = 8 if on_cpu else IMAGE_FMAP
-    dalle = DALLE(
-        dim=DIM, depth=depth, num_text_tokens=NUM_TEXT, text_seq_len=TEXT_SEQ,
-        num_image_tokens=NUM_IMAGE, image_fmap_size=fmap,
-        heads=HEADS, dim_head=DIM_HEAD, attn_types=("full",),
-        dtype=jnp.bfloat16,
-    )
-    rng = np.random.RandomState(0)
-    text = jnp.asarray(rng.randint(1, NUM_TEXT, size=(1, TEXT_SEQ)), jnp.int32)
-    params = jax.jit(dalle.init)(
-        jax.random.key(0), text, jnp.zeros((1, fmap * fmap), jnp.int32)
-    )["params"]
     # bf16 (+ optional int8) serving: decode is HBM-bound on weight reads
     # (generate.py runs the same transform)
-    from dalle_pytorch_tpu.utils.quantize import prepare_for_serving
-
-    dalle, params = prepare_for_serving(dalle, params, int8=int8)
+    dalle, params, _, fmap = _serving_model(on_cpu, int8)
+    rng = np.random.RandomState(0)
+    text = jnp.asarray(rng.randint(1, NUM_TEXT, size=(1, TEXT_SEQ)), jnp.int32)
 
     def gen(key):
         return generate_image_tokens(dalle, params, text, key)
@@ -672,9 +882,10 @@ def main():
         _retry(lambda: bench_breakdown(on_cpu))
         return
     # selective sections for iterating (--gen / --patterns / --throughput /
-    # --vae / --clip); no flag = the full suite, headline train-MFU line LAST
-    only = {f for f in ("--gen", "--patterns", "--throughput", "--vae",
-                        "--clip") if f in sys.argv}
+    # --sweep / --ragged / --vae / --clip); no flag = the full suite,
+    # headline train-MFU line LAST
+    only = {f for f in ("--gen", "--patterns", "--throughput", "--sweep",
+                        "--ragged", "--vae", "--clip") if f in sys.argv}
     if only:
         gen_int8 = None
         if "--gen" in only:
@@ -687,6 +898,11 @@ def main():
                 lambda: bench_gen_throughput(on_cpu, base_ms_per_token=base)
             ):
                 print(json.dumps(r))
+        if "--sweep" in only:
+            for r in _retry(lambda: bench_decode_sweep(on_cpu)):
+                print(json.dumps(r))
+        if "--ragged" in only:
+            print(json.dumps(_retry(lambda: bench_continuous_batching(on_cpu))))
         if "--patterns" in only:
             for r in _retry(lambda: bench_sparse_patterns(on_cpu)):
                 print(json.dumps(r))
@@ -705,6 +921,12 @@ def main():
         on_cpu, base_ms_per_token=gen_int8["ms_per_token"]
     )):
         print(json.dumps(r))
+    # paged-only sweep in the full suite (the policy-default formats are
+    # already covered by the latency/throughput sections above); the full
+    # 3-format matrix runs under --sweep
+    for r in _retry(lambda: bench_decode_sweep(on_cpu, formats=("paged",))):
+        print(json.dumps(r))
+    print(json.dumps(_retry(lambda: bench_continuous_batching(on_cpu))))
     for r in _retry(lambda: bench_sparse_patterns(on_cpu)):
         print(json.dumps(r))
     print(json.dumps(_retry(lambda: bench_vae_train(on_cpu))))

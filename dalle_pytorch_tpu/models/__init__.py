@@ -7,6 +7,8 @@ from .sampling import (
     generate_images,
     generate_texts,
     init_decode_cache,
+    merge_decode_caches,
+    set_decode_offsets,
 )
 from .transformer import Transformer
 from .vae import DiscreteVAE, ResBlock, denormalize, gumbel_softmax, smooth_l1_loss
@@ -28,6 +30,8 @@ __all__ = [
     "gumbel_softmax",
     "init_decode_cache",
     "masked_mean",
+    "merge_decode_caches",
+    "set_decode_offsets",
     "smooth_l1_loss",
     "top_k_filter",
 ]

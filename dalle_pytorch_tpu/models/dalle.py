@@ -219,7 +219,12 @@ class DALLE(nn.Module):
         fewer head-weight bytes per token than the full head. The slice
         starts at the 128-aligned column below ``ext`` so the (int8 or bf16)
         kernel read stays tile-aligned; the few extra text columns are
-        dropped from the result."""
+        dropped from the result. The dequant/matvec arithmetic itself lives
+        in ``dense_apply_columns`` (ops/layers.py), the one shared contract
+        with QuantDense — this sliced head cannot diverge from the full
+        head's math."""
+        from ..ops.layers import dense_apply_columns
+
         if self.stable:
             out = divide_max(out)
         normed = self.final_norm(out)
@@ -228,17 +233,7 @@ class DALLE(nn.Module):
         p = self.variables["params"]["to_logits"]
         ext = self.num_text_tokens_ext
         lo = (ext // 128) * 128
-        h = normed.astype(self.dtype)
-        if "kernel_q" in p:
-            # mirror QuantDense: int8 columns widened in-register, then the
-            # per-output-channel scale (ops/layers.py:QuantDense)
-            q = jnp.asarray(p["kernel_q"])[:, lo:]
-            logits = (h @ q.astype(self.dtype)) * jnp.asarray(p["scale"])[
-                lo:
-            ].astype(self.dtype)
-        else:
-            logits = h @ jnp.asarray(p["kernel"], self.dtype)[:, lo:]
-        logits = logits + jnp.asarray(p["bias"])[lo:].astype(self.dtype)
+        logits = dense_apply_columns(p, normed, lo, self.dtype)
         return logits[..., ext - lo :].astype(jnp.float32)
 
     # ------------------------------------------------------------- forward
@@ -394,6 +389,13 @@ class DALLE(nn.Module):
         models/sampling.py) — every layer sweeps whatever extent it is
         handed (Attention._decode_attend).
 
+        ``pos`` may be a SCALAR (the whole batch at one position — the
+        decode scan) or a (b,) VECTOR of per-sequence positions (ragged
+        decode offsets / continuous batching). The vector form requires a
+        paged cache (per-sequence write indices, ops/attention.py) and
+        ``rotary_emb=True`` (the learned positional tables' decode path
+        slices by a single position).
+
         ``image_only`` (static) asserts pos + 1 is an image position and
         computes only the image-vocab slice of the head, returning
         (b, num_image_tokens) logits — exactly the full head's ``[ext:]``
@@ -404,12 +406,17 @@ class DALLE(nn.Module):
         per-step op sequence.
         """
         b = token.shape[0]
+        ragged = jnp.ndim(pos) == 1
+        assert not (ragged and not self.rotary_emb), (
+            "ragged decode offsets require rotary_emb=True"
+        )
         is_text = pos < self.text_len_internal
 
         text_tok = jnp.clip(token, 0, self.num_text_tokens_ext - 1)
         img_tok = jnp.clip(token, 0, self.num_image_tokens - 1)
         emb = jnp.where(
-            is_text, self.text_emb(text_tok), self.image_emb(img_tok)
+            is_text[:, None] if ragged else is_text,
+            self.text_emb(text_tok), self.image_emb(img_tok),
         )
         if not self.rotary_emb:
             tpos = jnp.clip(pos, 0, self.text_len_internal - 1)
@@ -429,7 +436,7 @@ class DALLE(nn.Module):
         if image_only:
             return self._head_image(out)[:, 0]
         logits = self._head(out)[:, 0]
-        mask_row = jax.lax.dynamic_slice_in_dim(
-            jnp.asarray(self.logits_mask_np()), jnp.minimum(pos, self.total_seq_len - 1), 1, axis=0
-        )
+        lm = jnp.asarray(self.logits_mask_np())
+        p = jnp.minimum(pos, self.total_seq_len - 1)
+        mask_row = lm[p] if ragged else jax.lax.dynamic_slice_in_dim(lm, p, 1, axis=0)
         return jnp.where(mask_row, NEG_INF, logits)

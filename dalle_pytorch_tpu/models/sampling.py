@@ -15,12 +15,14 @@ image-token priming (reference dalle_pytorch.py:470-479) and CLIP reranking
 
 from __future__ import annotations
 
+import contextlib
 from functools import partial
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..ops import kv_policy, paged_kv
 from .dalle import DALLE, top_k_filter
 
 # Cache-window growth granularity for the segmented decode scan below.
@@ -34,20 +36,107 @@ DECODE_WINDOW_SEG = None
 DECODE_UNROLL = 4
 
 
-def init_decode_cache(dalle: DALLE, params, batch_size: int):
-    """Materialize the transformer's KV/shift caches for a batch."""
+def _format_ctx(cache_format: Optional[str]):
+    """Pin the KV layout for a traced block when the caller asked for one;
+    ``None`` leaves the policy (or an enclosing override) in charge."""
+    if cache_format is None:
+        return contextlib.nullcontext()
+    return kv_policy.format_override(cache_format)
+
+
+def init_decode_cache(
+    dalle: DALLE, params, batch_size: int, cache_format: Optional[str] = None
+):
+    """Materialize the transformer's KV/shift caches for a batch.
+
+    ``cache_format`` pins the KV layout ("paged" | "flat" | "4d"); None
+    defers to the batch-size policy (ops/kv_policy.py)."""
     token = jnp.zeros((batch_size,), dtype=jnp.int32)
-    _, mutated = dalle.apply(
-        {"params": params},
-        token,
-        jnp.array(0, jnp.int32),
-        method=DALLE.decode_step,
-        mutable=["cache"],
-    )
+    with _format_ctx(cache_format):
+        _, mutated = dalle.apply(
+            {"params": params},
+            token,
+            jnp.array(0, jnp.int32),
+            method=DALLE.decode_step,
+            mutable=["cache"],
+        )
     return mutated["cache"]
 
 
-@partial(jax.jit, static_argnums=(0, 5, 8, 9, 10))
+def set_decode_offsets(cache, offsets):
+    """Place each sequence of a PAGED decode cache at its own offset —
+    the continuous-batching entry point (requests at different decode
+    positions share one step). Rewrites every per-position index in the
+    cache tree: the attention K/V write index (already (b,) for paged)
+    and the token-shift ring index (scalar -> (b,)). The flat/4-D formats
+    store a scalar index and cannot express ragged offsets — attention
+    would broadcast the vector wrongly, so this guards against them.
+
+    The caller owns cache CONTENTS: rows at positions >= offsets[i] must
+    be zeros/stale-masked (true after init + per-sequence replay or
+    ``merge_decode_caches``)."""
+    leaves = jax.tree_util.tree_leaves_with_path(cache)
+    leaf_keys = {getattr(p[-1], "key", None) for p, _ in leaves}
+    if "cached_key" in leaf_keys:
+        raise ValueError(
+            "ragged decode offsets need the paged cache format "
+            '(init_decode_cache(..., cache_format="paged"))'
+        )
+    if "gate_index" in leaf_keys:
+        raise ValueError(
+            "ragged decode offsets are unsupported for gMLP ('mlp') layers: "
+            "the spatial-gate history (ops/layers.py:SpatialGatingUnit) "
+            "indexes by a scalar absolute position"
+        )
+    offsets = jnp.asarray(offsets, jnp.int32)
+    assert offsets.ndim == 1, f"offsets must be (b,), got {offsets.shape}"
+    batches = {
+        x.shape[0] for p, x in leaves
+        if getattr(p[-1], "key", None) == "cached_key_pages"
+    }
+    if batches != {offsets.shape[0]}:
+        raise ValueError(
+            f"offsets length {offsets.shape[0]} != cache batch {sorted(batches)}"
+            " — a mismatched vector would broadcast into wrong-position writes"
+        )
+
+    def fn(path, x):
+        if getattr(path[-1], "key", None) in ("cache_index", "shift_index"):
+            return offsets
+        return x
+
+    return jax.tree_util.tree_map_with_path(fn, cache)
+
+
+def merge_decode_caches(caches):
+    """Stack per-sequence PAGED decode caches (each batch-1, at its own
+    decode offset) into one batched cache — how a continuous-batching
+    serving loop admits a newly-prefilled request into a running batch.
+    Batched leaves concatenate on axis 0; scalar indices (the token-shift
+    ring's) stack into (b,) vectors. Paged-only, and no gMLP layers, for
+    the same reasons as ``set_decode_offsets``."""
+    for c in caches:
+        keys = {
+            getattr(p[-1], "key", None)
+            for p, _ in jax.tree_util.tree_leaves_with_path(c)
+        }
+        if "cached_key" in keys:
+            raise ValueError("merge_decode_caches requires paged caches")
+        if "gate_index" in keys:
+            raise ValueError(
+                "merge_decode_caches cannot merge gMLP ('mlp') caches: the "
+                "spatial-gate history indexes by a scalar absolute position"
+            )
+
+    def merge(*leaves):
+        if leaves[0].ndim == 0:
+            return jnp.stack(leaves)
+        return jnp.concatenate(leaves, axis=0)
+
+    return jax.tree_util.tree_map(merge, *caches)
+
+
+@partial(jax.jit, static_argnums=(0, 5, 8, 9, 10, 11))
 def decode_tokens(
     dalle: DALLE,
     params,
@@ -60,6 +149,7 @@ def decode_tokens(
     num_steps: Optional[int] = None,
     prefill_len: int = 0,
     window_seg: Optional[int] = None,
+    cache_format: Optional[str] = None,
 ):
     """Run the decode scan over the internal token buffer.
 
@@ -85,7 +175,26 @@ def decode_tokens(
     override and then the batch-adaptive default below; 0 disables
     segmentation. Passing it explicitly keeps the knob trace-visible
     (a mutated module global is ignored by already-cached jit traces).
+
+    ``cache_format`` (static): the decode KV layout, "paged" | "flat" |
+    "4d"; None defers to the batch-size policy (ops/kv_policy.py). Static
+    so the format participates in the jit cache key; the override context
+    wraps the whole traced body, so every layer's cache declaration sees
+    the same pinned format.
     """
+    b, n_internal = tokens.shape
+    fmt = kv_policy.resolve_format(cache_format, b)
+    with kv_policy.format_override(fmt):
+        return _decode_tokens_body(
+            dalle, params, tokens, known_len, key, filter_thres, temperature,
+            mask, num_steps, prefill_len, window_seg,
+        )
+
+
+def _decode_tokens_body(
+    dalle, params, tokens, known_len, key, filter_thres, temperature,
+    mask, num_steps, prefill_len, window_seg,
+):
     b, n_internal = tokens.shape
     steps = n_internal - 1 if num_steps is None else num_steps
     text_len_internal = dalle.text_len_internal
@@ -159,17 +268,42 @@ def decode_tokens(
         (ops/attention.py:_decode_attend), so a smaller ARRAY — not a
         sliced view, which XLA materializes as a per-step copy (measured
         +0.11 ms/token, v5e int8) — is what makes a short window cheap.
-        Only the K/V caches resize: the token-shift history is already a
-        fixed-size ring (ops/layers.py:PreShiftToken) and the gMLP gate
-        history indexes by absolute position at full extent."""
+        Paged caches resize at PAGE granularity: pools and page tables
+        truncate/grow in lockstep on the page axis (tables are identity
+        inside a jitted generation — ops/paged_kv.py:identity_table — so
+        surviving entries stay valid and grown entries extend the
+        identity). Only the K/V caches resize: the token-shift history is
+        already a fixed-size ring (ops/layers.py:PreShiftToken) and the
+        gMLP gate history indexes by absolute position at full extent."""
+        page = kv_policy.page_size()
+        n_p = paged_kv.num_pages(W, page)
+
         def fn(path, x):
-            if getattr(path[-1], "key", None) in ("cached_key", "cached_value"):
+            key = getattr(path[-1], "key", None)
+            if key in ("cached_key", "cached_value"):
                 if x.shape[1] > W:
                     return x[:, :W]
                 if x.shape[1] < W:
                     return jnp.pad(
                         x, [(0, 0), (0, W - x.shape[1])] + [(0, 0)] * (x.ndim - 2)
                     )
+            elif key in ("cached_key_pages", "cached_value_pages"):
+                if x.shape[1] > n_p:
+                    return x[:, :n_p]
+                if x.shape[1] < n_p:
+                    return jnp.pad(
+                        x, [(0, 0), (0, n_p - x.shape[1]), (0, 0), (0, 0)]
+                    )
+            elif key == "page_table":
+                cur = x.shape[1]
+                if cur > n_p:
+                    return x[:, :n_p]
+                if cur < n_p:
+                    grown = jnp.broadcast_to(
+                        jnp.arange(cur, n_p, dtype=x.dtype)[None],
+                        (x.shape[0], n_p - cur),
+                    )
+                    return jnp.concatenate((x, grown), axis=1)
             return x
 
         return jax.tree_util.tree_map_with_path(fn, cache)
@@ -225,6 +359,7 @@ def generate_image_tokens(
     prime_tokens: Optional[jnp.ndarray] = None,
     mask: Optional[jnp.ndarray] = None,
     window_seg: Optional[int] = None,
+    cache_format: Optional[str] = None,
 ) -> jnp.ndarray:
     """text: (b, text_seq_len) raw ids -> sampled image token ids
     (b, image_seq_len)."""
@@ -251,6 +386,7 @@ def generate_image_tokens(
         dalle, params, tokens, known_len, key,
         filter_thres=filter_thres, temperature=temperature, mask=mask,
         prefill_len=dalle.text_len_internal, window_seg=window_seg,
+        cache_format=cache_format,
     )
     return tokens[:, dalle.text_len_internal :]
 

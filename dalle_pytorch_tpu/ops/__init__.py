@@ -1,4 +1,4 @@
-from . import masks, rotary
+from . import kv_policy, masks, paged_kv, rotary
 from .attention import PatternAttention, dense_attend
 from .flash_attention import StaticMask, flash_attention
 from .layers import (
@@ -19,7 +19,9 @@ from .ring_attention import ring_attention, ulysses_attend
 from .rotary import apply_rotary_emb, dalle_rotary_table
 
 __all__ = [
+    "kv_policy",
     "masks",
+    "paged_kv",
     "rotary",
     "PatternAttention",
     "dense_attend",

@@ -27,7 +27,6 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -217,6 +216,7 @@ class PatternAttention(nn.Module):
                 and self.attn_type == "full"
                 and self.causal
                 and _dk.fused_decode_supported(h, d)
+                and self._cache_format(b) != "paged"
                 and not self._has_windowed_cache()
             ):
                 # OPT-IN fused decode kernel (ops/decode_attention.py):
@@ -368,7 +368,9 @@ class PatternAttention(nn.Module):
 
         args = (q, k, v) if mask is None else (q, k, v, mask[:, :n])
         in_specs = (qspec,) * 3 + ((mspec,) if mask is not None else ())
-        return jax.shard_map(
+        from .jax_compat import shard_map
+
+        return shard_map(
             body, mesh=mesh, in_specs=in_specs, out_specs=qspec,
             check_vma=False,
         )(*args)
@@ -593,38 +595,45 @@ class PatternAttention(nn.Module):
 
     # ------------------------------------------------------------ decode path
 
-    def _decode_caches(self, b, dtype):
-        """The decode cache variables — ONE declaration shared by the fused
-        and unfused paths, so prefill (unfused) composes with fused
-        per-token steps on bit-identical caches.
+    def _cache_format(self, b: int) -> str:
+        """This decode call's cache layout format ("paged" | "flat" | "4d").
 
-        The cache ARRAY SHAPE is a measured, batch-conditional choice
-        (v5e-1 int8 flagship, 2026-07). 4-D (b, L, h, d) compiles to a
-        positions-minor layout ({1,3,2,0}) whose per-step one-row
-        dynamic-update-slice scatters across the whole buffer —
-        trace-measured at 43% of the batch-8 decode program. Folding the
-        head axis away, FLAT (b, L, h*d), flips the layout to
-        channels-on-lanes and fixes the update at mid batch (batch 8:
-        4,870 -> 6,705 tok/s) — but the SAME flat shape re-tips XLA's
-        layout choice the other way at batch 1 (0.660 -> 0.747 ms/token
-        int8, 0.900 -> 0.988 bf16) and batch 32 (6,075 -> 4,158 tok/s),
-        and an optimization_barrier on the cache reads changes none of it.
-        Batches 4 and 16 also prefer 4-D (3,829 vs 2,893 and 5,781 vs
-        4,032) — the flat win is a batch-8 phenomenon on this compiler,
-        not a trend; at batch 32 flat loses at every segment size tried
-        (seg 0/512/1024 all ~3.3-4.2k tok/s vs ~6.1-6.3k 4-D, so the 4-D
-        DUS tax there is the lesser evil and bounded). Policy: flat
-        exactly where it is proven (b == 8), 4-D otherwise; every
-        sweep/update site handles either rank, and DALLE_TPU_FLAT_KV=0/1
-        overrides for re-measurement at other shapes/compiler versions."""
+        A SUPPLIED cache's variables win (resized, merged, or replayed
+        caches keep the format they were built with); with no cache yet,
+        the layout policy decides (ops/kv_policy.py — the named, logged
+        replacement for the inline ``b == 8`` magic branch that used to
+        live here, with the full measured flat-vs-4-D history in its
+        docstring)."""
+        from . import kv_policy
+
+        if self.has_variable("cache", "cached_key_pages"):
+            return "paged"
+        if self.has_variable("cache", "cached_key"):
+            ck = self.get_variable("cache", "cached_key")
+            return "flat" if ck.ndim == 3 else "4d"
+        return kv_policy.choose_cache_format(b)
+
+    def _decode_caches(self, b, dtype):
+        """The flat/4-D decode cache variables — ONE declaration shared by
+        the fused and unfused paths, so prefill (unfused) composes with
+        fused per-token steps on bit-identical caches.
+
+        The flat-vs-4-D rank is a measured, batch-conditional layout choice
+        (v5e-1 int8 flagship, 2026-07): 4-D (b, L, h, d) compiles to a
+        positions-minor layout whose one-row dynamic-update-slice rewrites
+        the whole buffer (43% of the batch-8 decode program by trace);
+        FLAT (b, L, h*d) fixes that exactly at batch 8 (4,870 -> 6,705
+        tok/s) and loses at batches 1/4/16/32 on the same chip. The policy
+        lives in ops/kv_policy.py (4-D at b=1, flat at b=8, paged pools —
+        ``_paged_caches`` below — elsewhere); every sweep/update site here
+        handles either rank, and DALLE_TPU_KV_FORMAT / DALLE_TPU_FLAT_KV
+        override for re-measurement."""
         h, d, L = self.heads, self.dim_head, self.seq_len
-        force = os.environ.get("DALLE_TPU_FLAT_KV")
-        if force not in (None, "", "0", "1"):
-            raise ValueError(
-                f"DALLE_TPU_FLAT_KV must be '0' or '1', got {force!r}"
-            )
-        flat = (force == "1") if force in ("0", "1") else b == 8
-        kv_shape = (b, L, h * d) if flat else (b, L, h, d)
+        fmt = self._cache_format(b)
+        assert fmt in ("flat", "4d"), (
+            f"paged caches are declared by _paged_caches, not here ({fmt})"
+        )
+        kv_shape = (b, L, h * d) if fmt == "flat" else (b, L, h, d)
         is_init = not self.has_variable("cache", "cached_key")
         cached_key = self.variable(
             "cache", "cached_key", jnp.zeros, kv_shape, dtype
@@ -718,6 +727,8 @@ class PatternAttention(nn.Module):
         summation-order drift where the narrower einsum chunks
         differently)."""
         b, n, h, d = q.shape
+        if self._cache_format(b) == "paged":
+            return self._decode_attend_paged(q, k, v, mask, rotary_pos_emb)
 
         cached_key, cached_value, cache_index, is_init = self._decode_caches(
             b, k.dtype
@@ -749,6 +760,102 @@ class PatternAttention(nn.Module):
         )[None, None]  # (1, 1, n, W)
         if mask is not None:
             allowed = allowed & mask[:, None, None, :W]
+        return self._cache_attend(q, k_cache, v_cache, allowed)
+
+    # ------------------------------------------------------- paged decode
+
+    def _paged_caches(self, b, dtype):
+        """The block-paged decode cache variables (ops/paged_kv.py): K/V
+        page pools (b, n_pages, page, h*d), a per-sequence page table, and
+        a PER-SEQUENCE (b,) write index — the only cache format whose index
+        can express ragged decode offsets across the batch (continuous
+        batching). Page size comes from kv_policy.page_size()."""
+        from . import kv_policy, paged_kv
+
+        h, d, L = self.heads, self.dim_head, self.seq_len
+        page = kv_policy.page_size()
+        n_p = paged_kv.num_pages(L, page)
+        is_init = not self.has_variable("cache", "cached_key_pages")
+        pool_shape = (b, n_p, page, h * d)
+        k_pool = self.variable(
+            "cache", "cached_key_pages", jnp.zeros, pool_shape, dtype
+        )
+        v_pool = self.variable(
+            "cache", "cached_value_pages", jnp.zeros, pool_shape, dtype
+        )
+        table = self.variable("cache", "page_table", paged_kv.identity_table, b, n_p)
+        cache_index = self.variable(
+            "cache", "cache_index", jnp.zeros, (b,), jnp.int32
+        )
+        return k_pool, v_pool, table, cache_index, is_init
+
+    def _decode_attend_paged(self, q, k, v, mask, rotary_pos_emb):
+        """Decode against the block-paged cache: rotary rows, pattern-mask
+        rows, and the write position are all indexed PER SEQUENCE from the
+        (b,) cache index, so a batch whose sequences sit at different
+        decode offsets runs in one step (continuous batching — the
+        flat/4-D scalar-index formats cannot express it). The per-step
+        cache update is a one-row scatter inside one page per sequence;
+        the gather then assembles the logical (b, W, h*d) view (W = pages
+        * page_size, >= the frontier; rows past a sequence's own frontier
+        are zeros under a False pattern-mask column, the same masked-zeros
+        argument as the flat path). Attention arithmetic is the shared
+        ``_cache_attend``, so paged/flat/4-D parity is exact by
+        construction."""
+        from . import paged_kv
+
+        b, n, h, d = q.shape
+        k_pool, v_pool, table, cache_index, is_init = self._paged_caches(
+            b, k.dtype
+        )
+        if is_init:
+            return jnp.zeros_like(q)
+
+        idx = cache_index.value  # (b,)
+        pos = idx[:, None] + jnp.arange(n, dtype=idx.dtype)[None]  # (b, n)
+        if rotary_pos_emb is not None:
+            T = rotary_pos_emb.shape[0]
+            rows = jnp.take(rotary_pos_emb, jnp.minimum(pos, T - 1), axis=0)
+            q, k, v = (
+                apply_rotary_emb(rows[:, :, None, :], t) for t in (q, k, v)
+            )
+        q = q * (d**-0.5)
+
+        hd = h * d
+        k_pool.value = paged_kv.append(
+            k_pool.value, table.value, idx, k.reshape(b, n, hd)
+        )
+        v_pool.value = paged_kv.append(
+            v_pool.value, table.value, idx, v.reshape(b, n, hd)
+        )
+        cache_index.value = idx + n
+        k_cache = paged_kv.gather(k_pool.value, table.value)  # (b, W, h*d)
+        v_cache = paged_kv.gather(v_pool.value, table.value)
+        W = k_cache.shape[1]
+
+        pm = jnp.asarray(self.pattern_mask())  # (L, L)
+        L = pm.shape[0]
+        pm = pm[:, :W] if W <= L else jnp.pad(pm, ((0, 0), (0, W - L)))
+        # per-sequence mask rows (jnp.take, clipped): row pos[b, j] of the
+        # pattern selects which cached keys step j of sequence b sees
+        allowed = jnp.take(pm, jnp.minimum(pos, L - 1), axis=0)  # (b, n, W)
+        if mask is not None:
+            km = mask[:, :W]
+            if km.shape[1] < W:
+                km = jnp.pad(km, ((0, 0), (0, W - km.shape[1])))
+            allowed = allowed & km[:, None, :]
+        return self._cache_attend(q, k_cache, v_cache, allowed[:, None])
+
+    # -------------------------------------------- shared cache arithmetic
+
+    def _cache_attend(self, q, k_cache, v_cache, allowed):
+        """Masked attention of q (b, n, h, d — pre-scaled) against a cache
+        view of W rows: k_cache/v_cache any (b, W, h*d)-reshapeable rank,
+        ``allowed`` broadcastable to (b, 1, n, W). ONE implementation
+        serves every cache format, so paged/flat/4-D can only differ in
+        storage, never in arithmetic."""
+        b, n, h, d = q.shape
+        W = k_cache.shape[1]
 
         if n == 1 and d < 128 and 128 % d == 0 and h % (128 // d) == 0:
             # lane-packed single-token sweeps: dim_head < 128 half-fills
@@ -795,7 +902,7 @@ class PatternAttention(nn.Module):
     # the QK+AV cache sweeps ~244 us, small ops ~100 us, head+sampling the
     # rest. The sweeps ran at only ~250 GB/s because dim_head=64 half-fills
     # the 128-lane tiles of the (b, L, h, d) caches. The lane-packed XLA
-    # reformulation in _decode_attend above (P heads per 128-lane tile,
+    # reformulation in _cache_attend above (P heads per 128-lane tile,
     # block-diagonal q — exact arithmetic) recovers part of that: measured
     # int8 0.823 -> 0.813 ms/token, bf16 1.044 -> 1.029 (reproduced twice).
     # The same packing done as a Pallas kernel (ops/decode_attention.py)

@@ -1,0 +1,43 @@
+"""Version-compat shims for JAX APIs that moved/renamed across releases.
+
+The tree targets current JAX (`jax.shard_map`, `pltpu.CompilerParams`);
+older releases (<= 0.4.x, like some CI/container images) ship the same
+functionality as `jax.experimental.shard_map.shard_map(check_rep=...)` and
+`pltpu.TPUCompilerParams`. Every call site routes through here so the
+whole suite runs on either — the new API is the canonical spelling, the
+old one is adapted.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, axis_names=None):
+    """``jax.shard_map`` with the old experimental fallback: ``check_vma``
+    was ``check_rep`` there, and explicit ``axis_names`` were expressed as
+    the complementary ``auto`` set."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if axis_names is None else {"axis_names": axis_names}
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma, **kw,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    kw = {}
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma, **kw,
+    )
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (renamed from ``TPUCompilerParams``)."""
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

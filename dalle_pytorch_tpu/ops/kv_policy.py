@@ -1,0 +1,153 @@
+"""Decode KV-cache layout policy — one named decision point, observable.
+
+The decode cache's array layout used to be an inline magic branch
+(``flat = b == 8`` in ops/attention.py:_decode_caches): correct at the one
+measured point, a silent perf cliff everywhere near it, and invisible to
+users when it fell back. This module replaces it with a *policy*:
+
+- ``"paged"``  — block-paged cache (ops/paged_kv.py): fixed 128-token pages
+  in (b, n_pages, page, h*d) layout behind a per-sequence page table and a
+  per-sequence (b,) write index. The per-step update touches one page row,
+  so the update cost is a property of the CACHE, not of the batch size —
+  the structural fix for the 4-D layout's whole-buffer dynamic-update-slice
+  rewrites that made serving throughput non-monotone in batch (batch 32
+  measured 6,050 tok/s vs batch 8's 6,832 on v5e, BENCH_r05). Also the only
+  format with ragged per-sequence decode offsets (continuous batching).
+- ``"flat"``  — (b, L, h*d): the measured batch-8 winner (+38% tok/s over
+  4-D there, v5e 2026-07), and a measured LOSER at batches 1/4/16/32 on the
+  same chip/compiler.
+- ``"4d"``    — (b, L, h, d): the measured batch-1 winner (0.660 vs
+  0.747 ms/token int8); its one-row update compiles to a positions-minor
+  layout whose DUS tax grows with batch (trace-measured 43% of the batch-8
+  decode program before the flat fix).
+
+Default policy (the measured numbers above are the provenance): 4-D at
+batch 1, flat at batch 8, paged everywhere else. Batch 1 and 8 keep their
+proven layouts; every other batch — where 4-D was only ever the lesser
+evil — gets the format whose update cost does not scale with the buffer.
+Re-measure with ``bench.py --sweep`` on compiler/chip changes.
+
+Every choice is emitted once per (format, batch) through the
+``dalle_tpu.kv_policy`` logger and recorded in ``CHOICE_LOG`` so an
+unexpected layout fallback is observable (bench.py surfaces the format in
+its throughput records) instead of a silent perf cliff.
+
+Overrides, strongest first:
+- ``format_override(fmt)`` context manager (how an explicit
+  ``cache_format=`` argument reaches the attention layers at trace time);
+- ``DALLE_TPU_KV_FORMAT`` = paged|flat|4d;
+- legacy ``DALLE_TPU_FLAT_KV`` = 0|1 (maps to 4d|flat), kept for
+  re-measurement scripts.
+
+Environment overrides are read at TRACE time: flipping one under an
+already-cached jit requires ``jax.clear_caches()`` (the existing
+re-measurement workflow; tests do the same).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging
+import os
+from typing import Iterator, Optional
+
+logger = logging.getLogger("dalle_tpu.kv_policy")
+
+FORMATS = ("paged", "flat", "4d")
+
+DEFAULT_PAGE_SIZE = 128
+
+# every (format, batch, reason) decision made this process, in order — the
+# observable record bench.py attaches to its throughput entries
+CHOICE_LOG: list = []
+_EMITTED: set = set()
+
+# a ContextVar, not a module global: concurrent traces (a serving layer
+# jitting two generations with different formats on different threads)
+# must not see each other's override
+_OVERRIDE: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "dalle_tpu_kv_format_override", default=None
+)
+
+
+def page_size() -> int:
+    """Page row count; ``DALLE_TPU_KV_PAGE_SIZE`` overrides (tests use tiny
+    pages to exercise page-boundary arithmetic on small models)."""
+    raw = os.environ.get("DALLE_TPU_KV_PAGE_SIZE")
+    if raw in (None, ""):
+        return DEFAULT_PAGE_SIZE
+    size = int(raw)
+    if size <= 0:
+        raise ValueError(f"DALLE_TPU_KV_PAGE_SIZE must be > 0, got {raw!r}")
+    return size
+
+
+@contextlib.contextmanager
+def format_override(fmt: Optional[str]) -> Iterator[None]:
+    """Pin the cache format for every ``choose_cache_format`` call in the
+    block — the trace-time channel for an explicit ``cache_format=``
+    argument (models/sampling.py wraps its whole traced body in this, so
+    the format participates in the jit cache key as a static argument
+    rather than as hidden module state)."""
+    if fmt is not None and fmt not in FORMATS:
+        raise ValueError(f"cache_format must be one of {FORMATS}, got {fmt!r}")
+    token = _OVERRIDE.set(fmt)
+    try:
+        yield
+    finally:
+        _OVERRIDE.reset(token)
+
+
+def _emit(fmt: str, batch: int, reason: str) -> None:
+    key = (fmt, batch, reason)
+    CHOICE_LOG.append({"cache_format": fmt, "batch": batch, "reason": reason})
+    if key in _EMITTED:
+        return
+    _EMITTED.add(key)
+    logger.info("decode KV cache format: %s (batch=%d, %s)", fmt, batch, reason)
+
+
+def choose_cache_format(batch: int) -> str:
+    """Resolve the decode cache format for a batch (called at trace time by
+    ops/attention.py when no cache exists yet). See module docstring for the
+    policy and its measured provenance."""
+    override = _OVERRIDE.get()
+    if override is not None:
+        fmt, reason = override, "explicit override"
+    else:
+        env = os.environ.get("DALLE_TPU_KV_FORMAT")
+        legacy = os.environ.get("DALLE_TPU_FLAT_KV")
+        if env not in (None, ""):
+            if env not in FORMATS:
+                raise ValueError(
+                    f"DALLE_TPU_KV_FORMAT must be one of {FORMATS}, got {env!r}"
+                )
+            fmt, reason = env, "DALLE_TPU_KV_FORMAT"
+        elif legacy not in (None, ""):
+            if legacy not in ("0", "1"):
+                raise ValueError(
+                    f"DALLE_TPU_FLAT_KV must be '0' or '1', got {legacy!r}"
+                )
+            fmt, reason = ("flat" if legacy == "1" else "4d"), "DALLE_TPU_FLAT_KV"
+        elif batch == 1:
+            fmt, reason = "4d", "policy: measured batch-1 layout (v5e 2026-07)"
+        elif batch == 8:
+            fmt, reason = "flat", "policy: measured batch-8 layout (v5e 2026-07)"
+        else:
+            fmt, reason = "paged", "policy: batch-invariant page-local updates"
+    _emit(fmt, batch, reason)
+    return fmt
+
+
+def resolve_format(cache_format: Optional[str], batch: int) -> str:
+    """An explicit ``cache_format`` argument wins; ``None`` defers to the
+    policy. Entry point for models/sampling.py."""
+    if cache_format is not None:
+        if cache_format not in FORMATS:
+            raise ValueError(
+                f"cache_format must be one of {FORMATS}, got {cache_format!r}"
+            )
+        _emit(cache_format, batch, "cache_format argument")
+        return cache_format
+    return choose_cache_format(batch)

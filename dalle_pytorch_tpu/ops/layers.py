@@ -96,26 +96,50 @@ class QuantDense(nn.Module):
     @nn.compact
     def __call__(self, x):
         in_features = x.shape[-1]
-        q = self.param(
-            "kernel_q",
-            lambda key, shape: jnp.zeros(shape, jnp.int8),
-            (in_features, self.features),
-        )
-        scale = self.param(
-            "scale",
-            lambda key, shape: jnp.ones(shape, jnp.float32),
-            (self.features,),
-        )
-        x = x.astype(self.dtype)
-        y = (x @ q.astype(self.dtype)) * scale.astype(self.dtype)
+        params = {
+            "kernel_q": self.param(
+                "kernel_q",
+                lambda key, shape: jnp.zeros(shape, jnp.int8),
+                (in_features, self.features),
+            ),
+            "scale": self.param(
+                "scale",
+                lambda key, shape: jnp.ones(shape, jnp.float32),
+                (self.features,),
+            ),
+        }
         if self.use_bias:
-            bias = self.param(
+            params["bias"] = self.param(
                 "bias",
                 lambda key, shape: jnp.zeros(shape, self.param_dtype),
                 (self.features,),
             )
-            y = y + bias.astype(self.dtype)
-        return y
+        # the full matvec IS the all-columns slice: one implementation
+        # (``dense_apply_columns``) serves this module and the sliced image
+        # head (models/dalle.py:_head_image), so the two cannot diverge
+        return dense_apply_columns(params, x, 0, self.dtype)
+
+
+def dense_apply_columns(params, x: jnp.ndarray, lo: int, dtype) -> jnp.ndarray:
+    """The ``[lo:]`` output-column slice of a (Quant)Dense matvec, computed
+    from the module's raw param dict — the ONE place the sliced-head
+    arithmetic lives, shared between ``QuantDense.__call__``'s math and
+    column-sliced consumers (models/dalle.py:_head_image). Handles both the
+    int8 serving params ({kernel_q, scale}) and the full-precision
+    ({kernel}) layout, bias included when present; the slice of the matvec
+    is exact (column j of ``x @ W + b`` depends only on column j of W/b),
+    so streaming fewer weight bytes never changes the kept outputs."""
+    x = x.astype(dtype)
+    if "kernel_q" in params:
+        # QuantDense: int8 columns widened in-register, then the
+        # per-output-channel scale
+        q = jnp.asarray(params["kernel_q"])[:, lo:]
+        y = (x @ q.astype(dtype)) * jnp.asarray(params["scale"])[lo:].astype(dtype)
+    else:
+        y = x @ jnp.asarray(params["kernel"], dtype)[:, lo:]
+    if "bias" in params:
+        y = y + jnp.asarray(params["bias"])[lo:].astype(dtype)
+    return y
 
 
 class QuantEmbed(nn.Module):
@@ -460,10 +484,15 @@ def shift_tokens_decode(
 ) -> jnp.ndarray:
     """Single-position token shift for the KV-cached decode loop.
 
-    x: (b, 1, d) current token features; pos: scalar int32 global position;
-    prev_token / row_above_token: (b, 1, d) features of positions pos-1 and
-    pos-image_size (zeros when out of range / across a boundary).
+    x: (b, 1, d) current token features; pos: scalar int32 global position,
+    or (b,) per-sequence positions (ragged decode offsets / continuous
+    batching — every position test below is elementwise, so the vector form
+    broadcasts over the batch); prev_token / row_above_token: (b, 1, d)
+    features of positions pos-1 and pos-image_size (zeros when out of
+    range / across a boundary).
     """
+    if jnp.ndim(pos) == 1:
+        pos = pos[:, None, None]  # broadcast per-sequence over (b, 1, d)
     d = x.shape[-1]
     is_text = pos < text_len
     p_img = pos - text_len

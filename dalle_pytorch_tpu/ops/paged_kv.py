@@ -1,0 +1,143 @@
+"""Block-paged decode KV cache: alloc / append / gather over fixed-size pages.
+
+Ragged Paged Attention (PAPERS.md) is the TPU-native answer to the
+batch-conditional cache-layout hack this repo carried (flat at batch 8,
+4-D elsewhere — ops/attention.py:_decode_caches history): store K/V in
+fixed-size pages of ``page_size`` tokens, reach them through a per-sequence
+page table, and make the decode step's cache update a PAGE-LOCAL write. The
+layout is then a property of the cache, not of the batch size:
+
+- pools are ``(b, n_pages, page_size, h*d)`` — the minor two dims (one
+  page) are identical at every batch size, so XLA's layout choice cannot
+  re-tip per batch the way the flat/(b, L, h*d) vs 4-D/(b, L, h, d) ranks
+  did (the root cause of serving throughput being non-monotone in batch:
+  batch 32 measured 6,050 tok/s below batch 8's 6,832, BENCH_r05);
+- the per-step append is a one-row scatter inside one page per sequence —
+  never the whole-buffer dynamic-update-slice rewrite the 4-D layout
+  compiled to (trace-measured 43% of the batch-8 decode program);
+- the write index is PER SEQUENCE (``(b,)`` int32), so requests at
+  different decode offsets share one step — continuous batching. The
+  flat/4-D formats' scalar index cannot express that;
+- the page table indirection (identity inside one jitted generation) is
+  the seam a serving layer needs for page reuse / prefix sharing across
+  requests without recompiling.
+
+Two XLA formulations of the page gather were built and measured (CPU,
+this box, 2026-08; pools (8, 10, 128, 1024) bf16, jitted, best of 50):
+
+- ``take``   — ``jnp.take_along_axis`` down the page axis: 0.47 ms/gather.
+  XLA fuses the row gather into the consuming attention einsum's operand
+  read on TPU, so no (b, L, h*d) copy materializes in HBM.
+- ``onehot`` — one-hot(table) matmul against the pool (gather as MXU
+  work): 23.5 ms/gather on CPU, ~50x slower — the (b, n_pages, n_pages)
+  one-hot contraction re-reads the whole pool per logical page. Kept for
+  re-measurement (``DALLE_TPU_PAGED_GATHER=onehot``) because on TPU a
+  skinny matmul sometimes beats the gather unit; the CPU loser's numbers
+  stay recorded here either way.
+
+A third option — extending the fused Pallas decode kernel
+(ops/decode_attention.py) with page-table scalar prefetch — was REJECTED
+without building it: that kernel is already a measured negative result for
+this decode shape (~29 us/layer vs ~10 us for the XLA op chain it
+replaces, v5e; its module docstring), and paging adds an indirection per
+K/V block on top of the same skinny-MXU serialization. Revisit only if a
+TPU sweep (bench.py --sweep) shows the take-gather path bound on gather
+overhead rather than on page bytes.
+
+All functions are pure array ops (no flax state); ops/attention.py owns
+the cache variables and calls these.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .kv_policy import DEFAULT_PAGE_SIZE
+
+
+def gather_variant() -> str:
+    """``take`` (default) or ``onehot`` — see the measured comparison in the
+    module docstring."""
+    v = os.environ.get("DALLE_TPU_PAGED_GATHER", "take")
+    if v not in ("take", "onehot"):
+        raise ValueError(
+            f"DALLE_TPU_PAGED_GATHER must be 'take' or 'onehot', got {v!r}"
+        )
+    return v
+
+
+def num_pages(length: int, page_size: int = DEFAULT_PAGE_SIZE) -> int:
+    """Pages needed to hold ``length`` tokens (ceil division)."""
+    assert page_size > 0, page_size
+    return -(-length // page_size)
+
+
+def alloc(
+    batch: int,
+    length: int,
+    feat: int,
+    page_size: int = DEFAULT_PAGE_SIZE,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """A zeroed page pool covering ``length`` tokens:
+    (batch, num_pages, page_size, feat)."""
+    return jnp.zeros((batch, num_pages(length, page_size), page_size, feat), dtype)
+
+
+def identity_table(batch: int, n_pages: int) -> jnp.ndarray:
+    """(batch, n_pages) page table mapping logical page i -> physical page i
+    within the sequence's own pool row. Identity is the invariant every
+    in-jit user keeps (resize_kv relies on it to truncate/grow pools and
+    tables in lockstep); a serving layer remapping pages would manage its
+    own tables."""
+    return jnp.broadcast_to(
+        jnp.arange(n_pages, dtype=jnp.int32)[None], (batch, n_pages)
+    )
+
+
+def append(
+    pool: jnp.ndarray,
+    table: jnp.ndarray,
+    index: jnp.ndarray,
+    rows: jnp.ndarray,
+) -> jnp.ndarray:
+    """Write ``rows`` (b, n, feat) at per-sequence positions
+    ``index`` (b,) .. index+n into the paged ``pool`` (b, n_pages, page, feat)
+    through ``table`` (b, n_pages). Returns the updated pool.
+
+    Positions may cross page boundaries mid-block (a prefill block spans
+    ceil(n/page) pages); each row lands in page ``pos // page`` at offset
+    ``pos % page``. Out-of-capacity positions are dropped, matching the
+    flat path's dynamic_update_slice clamp semantics at the buffer edge
+    only in never-read positions (callers guarantee index + n <= capacity).
+    """
+    b, n_p, page, feat = pool.shape
+    n = rows.shape[1]
+    pos = index[:, None] + jnp.arange(n, dtype=index.dtype)[None, :]  # (b, n)
+    logical = pos // page
+    off = pos % page
+    phys = jnp.take_along_axis(table, jnp.minimum(logical, n_p - 1), axis=1)
+    # drop (not clamp) genuinely out-of-capacity rows
+    phys = jnp.where(logical < n_p, phys, n_p)
+    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, n))
+    return pool.at[bidx, phys, off].set(rows, mode="drop")
+
+
+def gather(pool: jnp.ndarray, table: jnp.ndarray, variant=None) -> jnp.ndarray:
+    """Assemble the logical cache view (b, n_pages * page, feat) from the
+    paged pool. The ``take`` variant is the production path (the row gather
+    fuses into the consuming einsum); ``onehot`` is the measured-slower
+    MXU formulation kept for TPU re-measurement — numbers in the module
+    docstring."""
+    b, n_p, page, feat = pool.shape
+    if variant is None:
+        variant = gather_variant()
+    if variant == "onehot":
+        oh = jax.nn.one_hot(table, n_p, dtype=pool.dtype)  # (b, L_pages, n_p)
+        g = jnp.einsum("bln,bnpf->blpf", oh, pool)
+    else:
+        g = jnp.take_along_axis(pool, table[:, :, None, None], axis=1)
+    return g.reshape(b, n_p * page, feat)

@@ -23,6 +23,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # benchmarks (bench.py) never import this file and stay fully optimized.
 # Exported via the environment so CLI-subprocess e2e tests and the
 # multiprocess workers inherit it; set to 0 to override.
+# The blanket disable means parity tests exercise the UNOPTIMIZED pipeline;
+# the always-on counterweight is tests/test_optimized_smoke.py, a small
+# tier-1 subset (decode parity + attention parity) that re-enables the
+# optimization passes for its own compiles.
 os.environ.setdefault("JAX_DISABLE_MOST_OPTIMIZATIONS", "1")
 
 import jax  # noqa: E402

@@ -99,6 +99,10 @@ def test_dalle_decode_dispatches_kernel_and_matches_forward(monkeypatch):
 
     monkeypatch.setattr(DK, "FUSED_DECODE_ENABLED", True)
     monkeypatch.setattr(DK, "fused_decode_attention", spy)
+    # the fused kernel serves the flat/4-D cache formats only; batch 2
+    # defaults to the paged cache (ops/kv_policy.py), which correctly
+    # bypasses it — pin the historical 4-D layout for the dispatch spy
+    monkeypatch.setenv("DALLE_TPU_KV_FORMAT", "4d")
 
     dalle = _kernel_dalle()
     rng = np.random.RandomState(0)

@@ -286,7 +286,11 @@ class TestDALLE:
         internal = dalle.remap_text(text)
         T = dalle.text_len_internal
 
-        cache = init_decode_cache(dalle, params, batch_size=2)
+        # pin the 4-D format: this test's truncate_kv exercises the
+        # flat/4-D row-windowing path (batch 2 now defaults to the paged
+        # cache, whose page-granular windowing is covered by
+        # tests/test_paged_kv.py)
+        cache = init_decode_cache(dalle, params, batch_size=2, cache_format="4d")
         _, mutated = dalle.apply(
             {"params": params, "cache": cache},
             internal,
