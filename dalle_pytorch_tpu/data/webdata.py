@@ -24,10 +24,17 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 from PIL import Image
 
+from ..utils.faults import FAULTS
+from ..utils.metrics import counters
+from ..utils.resilience import RetryPolicy, retry
 from .loader import image_to_array, random_resized_crop
 
 IMAGE_KEYS = ("jpg", "jpeg", "png", "img", "image")
 CAPTION_KEYS = ("txt", "caption", "text")
+
+# transient shard-stream failures (flaky GCS/http) retry with backoff;
+# DALLE_TPU_SHARD_RETRIES / DALLE_TPU_SHARD_BACKOFF override
+SHARD_RETRY = RetryPolicy(attempts=3, base_delay=0.5, retry_on=(OSError,))
 
 
 def expand_urls(spec: str) -> List[str]:
@@ -103,7 +110,11 @@ class TarImageTextDataset:
     """Iterable (tokens, image) stream over tar shards.
 
     Warn-and-continue on malformed samples (the reference's
-    wds.warn_and_continue, train_dalle.py:372).
+    wds.warn_and_continue, train_dalle.py:372) — but counted, never silent:
+    every drop lands in ``utils.metrics.counters`` under ``webdata.*``
+    (decode errors, shard opens/aborts, quarantines). A shard whose open
+    keeps failing after retries is QUARANTINED — skipped for the rest of
+    this dataset's life instead of re-hammering a dead URL every epoch.
     """
 
     def __init__(
@@ -120,7 +131,12 @@ class TarImageTextDataset:
         process_index: int = 0,
         process_count: int = 1,
         seed: int = 0,
+        retry_policy: Optional[RetryPolicy] = None,
     ):
+        self.retry_policy = (retry_policy or SHARD_RETRY).from_env(
+            "DALLE_TPU_SHARD"
+        )
+        self._quarantined: set = set()
         self.urls = expand_urls(urls)
         assert self.urls, f"no shards matched {urls}"
         self.text_len = text_len
@@ -166,10 +182,39 @@ class TarImageTextDataset:
                     img, self.image_size, self._rng, self.resize_ratio
                 )
                 image = image_to_array(img)
-        except Exception as e:  # warn-and-continue
+        except Exception as e:  # warn-and-continue, but accounted
+            counters.inc("webdata.decode_errors")
             print(f"tar sample skipped: {type(e).__name__}: {e}", file=sys.stderr)
             return None
         return tokens, image
+
+    def _open_with_retry(self, url: str):
+        """Open one shard, retrying transient failures; -> stream or None
+        (after quarantining). The ``shard_open`` fault site injects the
+        failures tests use to pin both paths."""
+
+        def attempt():
+            FAULTS.maybe_raise("shard_open", OSError("injected shard_open fault"))
+            return open_shard(url)
+
+        try:
+            stream = retry(
+                attempt,
+                self.retry_policy,
+                describe=f"open shard {url}",
+                on_retry=lambda i, e: counters.inc("webdata.shard_open_retries"),
+            )
+        except self.retry_policy.retry_on as e:
+            self._quarantined.add(url)
+            counters.inc("webdata.shards_quarantined")
+            print(
+                f"shard {url} quarantined after "
+                f"{self.retry_policy.attempts} attempts: {e}",
+                file=sys.stderr,
+            )
+            return None
+        counters.inc("webdata.shards_opened")
+        return stream
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         buf: List[Tuple[np.ndarray, np.ndarray]] = []
@@ -177,13 +222,17 @@ class TarImageTextDataset:
         if self.shuffle_buffer:
             self._rng.shuffle(shards)
         for url in shards:
-            try:
-                stream = open_shard(url)
-            except OSError as e:
-                print(f"shard {url} skipped: {e}", file=sys.stderr)
+            if url in self._quarantined:
+                counters.inc("webdata.quarantined_skips")
+                continue
+            stream = self._open_with_retry(url)
+            if stream is None:
                 continue
             try:
                 for raw in iter_tar_samples(stream):
+                    FAULTS.maybe_raise(
+                        "shard_read", tarfile.TarError("injected shard_read fault")
+                    )
                     mapped = self._map(raw)
                     if mapped is None:
                         continue
@@ -196,6 +245,9 @@ class TarImageTextDataset:
                     else:
                         yield mapped
             except tarfile.TarError as e:
+                # mid-shard corruption/truncation: keep what streamed,
+                # move on to the next shard — counted, not silent
+                counters.inc("webdata.shard_aborts")
                 print(f"shard {url} aborted: {e}", file=sys.stderr)
             finally:
                 stream.close()

@@ -25,11 +25,16 @@ from .sharding import opt_state_shardings, params_shardings, shard_pytree
 
 
 class TrainState(NamedTuple):
-    """Minimal pytree train state (step, params, opt_state)."""
+    """Minimal pytree train state (step, params, opt_state) plus the NaN
+    step-guard's device-side counters: ``skipped`` (total non-finite steps
+    rejected) and ``consec_skipped`` (current run of rejections — the
+    trainer hard-aborts past a threshold; docs/DESIGN.md §9)."""
 
     step: jnp.ndarray
     params: Any
     opt_state: Any
+    skipped: jnp.ndarray
+    consec_skipped: jnp.ndarray
 
 
 def create_train_state(
@@ -55,8 +60,17 @@ def create_train_state(
     )(params)
     o_shard = opt_state_shardings(opt_state, p_shard, runtime.mesh)
     replicated = NamedSharding(runtime.mesh, P())
-    state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state)
-    shardings = TrainState(step=replicated, params=p_shard, opt_state=o_shard)
+    # distinct zero buffers: the step is donated, and donating one buffer
+    # through several leaves is an XLA error
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state,
+        skipped=jnp.zeros((), jnp.int32),
+        consec_skipped=jnp.zeros((), jnp.int32),
+    )
+    shardings = TrainState(
+        step=replicated, params=p_shard, opt_state=o_shard,
+        skipped=replicated, consec_skipped=replicated,
+    )
     return state, shardings
 
 
@@ -69,6 +83,8 @@ def make_train_step(
     donate: bool = True,
     dynamic_lr: bool = False,
     data_shardings: Any = None,
+    nan_guard: bool = True,
+    nan_inject_step: Optional[int] = None,
 ):
     """Compile ``(state, batch, rng[, lr]) -> (state, loss[, aux])``.
 
@@ -80,6 +96,23 @@ def make_train_step(
     ``-lr`` scaling in the step — the optimizer chain must then end at
     unscaled update directions (e.g. ``scale_by_adam`` without ``scale``), so
     host-side schedulers (ReduceLROnPlateau) change lr without recompiling.
+
+    ``nan_guard=True`` (default) checks finiteness of the loss and the
+    global gradient norm INSIDE the compiled step and ``jnp.where``-selects
+    the prior params/opt_state when non-finite — a rejected step costs
+    nothing extra and never syncs the host (no ``lax.cond`` either: both
+    branches' values already exist, selection is cheaper than a branch on
+    TPU). On a finite step the selects are identity, so guarded and
+    unguarded steps are bit-identical (pinned in tests/test_resilience.py).
+    The returned loss doubles as the rejection signal: NaN whenever the
+    step was rejected (even when only the grads were non-finite), finite
+    otherwise — the host keys its batch-retry and the
+    K-consecutive-rejections abort (train_dalle.py --nan_abort_after) off
+    exactly the device's decision.
+
+    ``nan_inject_step`` is the fault hook (utils/faults.py nan_at_step):
+    the loss is forced to NaN at that global step, compiled in as a trace
+    constant — None (the default) adds nothing to the program.
     """
     replicated = NamedSharding(runtime.mesh, P())
 
@@ -104,11 +137,34 @@ def make_train_step(
         grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
         out, grads = grad_fn(state.params, batch, rng)
         loss, aux = out if has_aux else (out, None)
+        if nan_inject_step is not None:
+            loss = jnp.where(
+                state.step == nan_inject_step,
+                jnp.asarray(jnp.nan, loss.dtype), loss,
+            )
         updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
         if dynamic_lr:
             updates = jax.tree_util.tree_map(lambda u: -lr * u, updates)
         params = optax.apply_updates(state.params, updates)
-        new_state = TrainState(step=state.step + 1, params=params, opt_state=opt_state)
+        skipped, consec = state.skipped, state.consec_skipped
+        if nan_guard:
+            finite = jnp.isfinite(loss) & jnp.isfinite(optax.global_norm(grads))
+            keep = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(finite, n, o), new, old
+            )
+            params = keep(params, state.params)
+            opt_state = keep(opt_state, state.opt_state)
+            skipped = skipped + jnp.where(finite, 0, 1).astype(jnp.int32)
+            consec = jnp.where(finite, 0, consec + 1).astype(jnp.int32)
+            # the returned loss IS the rejection signal: NaN for ANY
+            # rejected step — including finite-loss/non-finite-grad — so
+            # the host's retry/abort verdict always agrees with the
+            # device's select
+            loss = jnp.where(finite, loss, jnp.asarray(jnp.nan, loss.dtype))
+        new_state = TrainState(
+            step=state.step + 1, params=params, opt_state=opt_state,
+            skipped=skipped, consec_skipped=consec,
+        )
         if has_aux:
             return new_state, loss, aux
         return new_state, loss

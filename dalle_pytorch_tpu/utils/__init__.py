@@ -1,17 +1,21 @@
 from .checkpoint import (
+    latest_verified_step,
     load_checkpoint,
     load_sharded_checkpoint,
     save_checkpoint,
     save_sharded_checkpoint,
+    verify_step_dir,
 )
 from .download import CACHE_DIR, download
-from .metrics import MetricsLogger, Throughput, mfu
+from .faults import FAULTS, FaultRegistry
+from .metrics import Counters, MetricsLogger, Throughput, counters, mfu
 from .quantize import (
     prepare_for_serving,
     quantize_dalle,
     quantize_kernel,
     quantize_params,
 )
+from .resilience import PreemptionHandler, RetryPolicy, retry
 from .schedules import (
     ConstantLR,
     ExponentialDecay,
@@ -22,12 +26,19 @@ from .schedules import (
 __all__ = [
     "CACHE_DIR",
     "ConstantLR",
+    "Counters",
     "ExponentialDecay",
+    "FAULTS",
+    "FaultRegistry",
     "MetricsLogger",
+    "PreemptionHandler",
     "ReduceLROnPlateau",
+    "RetryPolicy",
     "Throughput",
+    "counters",
     "download",
     "gumbel_temperature",
+    "latest_verified_step",
     "load_checkpoint",
     "load_sharded_checkpoint",
     "mfu",
@@ -35,6 +46,8 @@ __all__ = [
     "quantize_dalle",
     "quantize_kernel",
     "quantize_params",
+    "retry",
     "save_checkpoint",
     "save_sharded_checkpoint",
+    "verify_step_dir",
 ]

@@ -12,18 +12,29 @@ host's addressable shards in parallel — the right format for fsdp/tp-sharded
 TrainStates — plus the same ``aux.json`` hparams sidecar the reference keeps
 in ``auxiliary.pt``. Rotation keeps the newest N step dirs
 (cp_files_to_keep, train_dalle.py:523-526).
+
+Directory saves are two-phase committed (docs/DESIGN.md §9): after orbax
+finishes, every file in the step dir is checksummed into ``MANIFEST.json``
+and a ``COMMITTED`` marker lands last. ``load_sharded_checkpoint`` restores
+only verified step dirs and falls back to the newest verified one — a crash
+mid-save (or bit corruption on the newest dir) costs at most the steps since
+the previous verified save, never a poisoned restore.
 """
 
 from __future__ import annotations
 
 import json
 import shutil
+import sys
 from pathlib import Path
 from typing import Any, Optional
 
 import jax
 import numpy as np
 from flax import serialization
+
+from .faults import FAULTS
+from .resilience import COMMIT_NAME, verify_dir_manifest, write_dir_manifest
 
 _HEADER_KEY = "__dalle_tpu_meta__"
 
@@ -69,7 +80,9 @@ def save_sharded_checkpoint(
     keep_n: Optional[int] = None,
 ) -> str:
     """Write ``<ckpt_dir>/step_<n>/`` via orbax (each host writes its own
-    shards) plus an ``aux.json`` hparams sidecar; rotate old step dirs."""
+    shards), checksum+commit it, refresh the ``aux.json`` hparams sidecar
+    (atomically — a crash mid-write must not take out the resume metadata
+    for every older step), and rotate old step dirs."""
     import orbax.checkpoint as ocp
 
     root = Path(ckpt_dir)
@@ -77,13 +90,70 @@ def save_sharded_checkpoint(
     target = (root / f"step_{step:08d}").resolve()
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(target, state, force=True)
-    (root / "aux.json").write_text(json.dumps({"meta": meta or {}, "latest": step}))
+    # manifest/sidecar/rotation are single-writer: the orbax save above is
+    # the collective part (and synchronizes hosts); N hosts writing the
+    # same MANIFEST.json.tmp on a shared filesystem would race a truncated
+    # manifest into a COMMITTED dir
+    if jax.process_index() == 0:
+        # meta rides in the manifest too: on fallback to an older step the
+        # restored meta must describe THAT step, not the newest aux.json
+        # write
+        write_dir_manifest(target, extra={"step": step, "meta": meta or {}})
+        if FAULTS.take("ckpt_corrupt"):
+            _corrupt_one_file(target)
+        aux = root / "aux.json"
+        tmp = aux.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps({"meta": meta or {}, "latest": step}))
+        tmp.replace(aux)
 
-    if keep_n is not None:
-        steps = sorted(root.glob("step_*"))
-        for old in steps[:-keep_n]:
-            shutil.rmtree(old, ignore_errors=True)
+        if keep_n is not None:
+            # rotation counts only COMMITTED dirs — a torn leftover must
+            # not push the last good fallback out of the window. Torn dirs
+            # (no marker; crash-mid-save debris of the two-phase design)
+            # are junk and get pruned outright. Marker presence is cheap;
+            # full checksums stay a load-time concern.
+            committed, torn = [], []
+            for d in sorted(root.glob("step_*")):
+                (committed if (d / COMMIT_NAME).exists() else torn).append(d)
+            for old in torn + committed[:-keep_n]:
+                shutil.rmtree(old, ignore_errors=True)
     return str(target)
+
+
+def _corrupt_one_file(step_dir: Path) -> None:
+    """ckpt_corrupt fault: flip bytes in the largest payload file AFTER the
+    manifest committed — models post-commit bit rot / torn replication, the
+    case only checksum verification catches (a missing commit marker is the
+    easier torn-save case)."""
+    payload = [
+        p for p in step_dir.rglob("*")
+        if p.is_file() and p.name not in ("MANIFEST.json", "COMMITTED")
+    ]
+    victim = max(payload, key=lambda p: p.stat().st_size)
+    data = bytearray(victim.read_bytes())
+    for i in range(min(64, len(data))):
+        data[i] ^= 0xFF
+    victim.write_bytes(data)
+    print(f"fault ckpt_corrupt: flipped bytes in {victim}", file=sys.stderr)
+
+
+def verify_step_dir(step_dir: str) -> tuple[bool, str]:
+    """-> (ok, reason): commit marker present and every manifested file
+    passes size+sha256. The operator CLI is ``tools/verify_ckpt.py``."""
+    return verify_dir_manifest(step_dir)
+
+
+def latest_verified_step(ckpt_dir: str) -> Optional[int]:
+    """Newest step number whose dir verifies; None when none do (or the
+    dir doesn't exist) — the trainer's resume probe."""
+    root = Path(ckpt_dir)
+    if not root.is_dir():
+        return None
+    for path in sorted(root.glob("step_*"), reverse=True):
+        ok, _ = verify_dir_manifest(path)
+        if ok:
+            return int(path.name.split("_")[1])
+    return None
 
 
 def load_sharded_checkpoint(
@@ -91,20 +161,46 @@ def load_sharded_checkpoint(
     target: Any,
     step: Optional[int] = None,
     shardings: Any = None,
+    verify: bool = True,
 ) -> tuple[Any, dict, int]:
-    """Restore the newest (or given) step dir into ``target``'s structure,
-    placing leaves with ``shardings`` when given. -> (state, meta, step)."""
+    """Restore the newest VERIFIED (or given) step dir into ``target``'s
+    structure, placing leaves with ``shardings`` when given.
+    -> (state, meta, step).
+
+    Torn/corrupt step dirs are skipped with a warning and the newest
+    verified one wins — the pre-manifest behavior (``steps[-1]``) happily
+    restored a half-written dir left by a crash mid-save. An explicitly
+    requested ``step`` must itself verify; ``verify=False`` skips that
+    re-hash ONLY for a step the caller just verified (the trainer's
+    resume probe — checksumming a multi-GB checkpoint twice per launch
+    is real time)."""
     import orbax.checkpoint as ocp
 
     root = Path(ckpt_dir)
     aux = json.loads((root / "aux.json").read_text()) if (root / "aux.json").exists() else {}
     if step is None:
-        steps = sorted(root.glob("step_*"))
+        steps = sorted(root.glob("step_*"), reverse=True)
         assert steps, f"no step_* checkpoints under {ckpt_dir}"
-        path = steps[-1].resolve()
+        path = None
+        for cand in steps:
+            ok, reason = verify_dir_manifest(cand)
+            if ok:
+                path = cand.resolve()
+                break
+            print(
+                f"checkpoint {cand.name} skipped: {reason}", file=sys.stderr
+            )
+        assert path is not None, (
+            f"no verified step_* checkpoint under {ckpt_dir} "
+            f"({len(steps)} dirs present, all torn/corrupt — "
+            "run tools/verify_ckpt.py for per-file detail)"
+        )
         step = int(path.name.split("_")[1])
     else:
         path = (root / f"step_{step:08d}").resolve()
+        if verify:
+            ok, reason = verify_dir_manifest(path)
+            assert ok, f"requested checkpoint {path} failed verification: {reason}"
 
     if shardings is not None:
         abstract = jax.tree_util.tree_map(
@@ -118,4 +214,10 @@ def load_sharded_checkpoint(
     else:
         with ocp.PyTreeCheckpointer() as ckptr:
             state = ckptr.restore(path, item=target)
-    return state, aux.get("meta", {}), step
+    try:
+        meta = json.loads((path / "MANIFEST.json").read_text()).get("meta")
+    except (OSError, ValueError):
+        meta = None
+    if meta is None:
+        meta = aux.get("meta", {})
+    return state, meta, step

@@ -5,17 +5,36 @@ cache directory; only the per-host root process downloads while other local
 ranks wait at a barrier, preventing N processes from fetching the same
 checkpoint. On TPU pods JAX runs one process per host, so the local-root race
 is rare — the coordination hook stays for multi-process-per-host setups.
+
+Resilience (docs/DESIGN.md §9): the reference's single unguarded ``urlopen``
+(no timeout, stale ``.tmp`` left behind on crash) becomes a retried fetch
+with exponential backoff (``DALLE_TPU_DOWNLOAD_RETRIES`` /
+``DALLE_TPU_DOWNLOAD_BACKOFF`` override the policy), a socket timeout, and
+``.tmp`` cleanup on entry and on every failure — a crashed fetch can't wedge
+every later run. Retries/failures are tallied in ``metrics.counters``;
+failures are injectable via the ``download`` fault site.
 """
 
 from __future__ import annotations
 
 import os
 import shutil
+import urllib.error
 import urllib.request
 from pathlib import Path
 from typing import Optional
 
+from .faults import FAULTS
+from .metrics import counters
+from .resilience import RetryPolicy, retry
+
 CACHE_DIR = os.path.expanduser("~/.cache/dalle_tpu")
+
+DOWNLOAD_RETRY = RetryPolicy(
+    attempts=3,
+    base_delay=0.5,
+    retry_on=(urllib.error.URLError, TimeoutError, OSError),
+)
 
 
 def download(
@@ -23,27 +42,55 @@ def download(
     filename: Optional[str] = None,
     root: str = CACHE_DIR,
     runtime=None,
+    timeout: Optional[float] = 60.0,
+    policy: Optional[RetryPolicy] = None,
 ) -> str:
     """Fetch ``url`` into ``root`` (once per host) and return the local path.
 
     ``runtime`` (a MeshRuntime) gates the fetch to the local root worker and
     barriers the rest — the reference's local_barrier dance (vae.py:67-74).
+    ``timeout`` is the per-connection socket timeout handed to ``urlopen``
+    (``DALLE_TPU_DOWNLOAD_TIMEOUT`` overrides).
     """
     filename = filename or url.split("/")[-1]
     path = Path(root) / filename
     if path.exists():
         return str(path)
 
+    env_timeout = os.environ.get("DALLE_TPU_DOWNLOAD_TIMEOUT")
+    if env_timeout is not None:
+        timeout = float(env_timeout)  # timeout=None (no limit) stays valid
+    policy = (policy or DOWNLOAD_RETRY).from_env("DALLE_TPU_DOWNLOAD")
+    tmp = path.with_suffix(path.suffix + ".tmp")
+
     is_local_root = runtime is None or runtime.is_local_root_worker()
     if is_local_root:
         path.parent.mkdir(parents=True, exist_ok=True)
-        tmp = path.with_suffix(path.suffix + ".tmp")
-        if url.startswith(("http://", "https://")):
-            with urllib.request.urlopen(url) as r, open(tmp, "wb") as f:
-                shutil.copyfileobj(r, f)
-        else:  # local/NFS path "url"s work too (common on pods)
-            shutil.copyfile(url, tmp)
-        tmp.replace(path)
+        if tmp.exists():  # stale leftover from a crashed earlier run
+            tmp.unlink()
+
+        def fetch():
+            FAULTS.maybe_raise(
+                "download", urllib.error.URLError("injected download fault")
+            )
+            if url.startswith(("http://", "https://")):
+                with urllib.request.urlopen(url, timeout=timeout) as r, \
+                        open(tmp, "wb") as f:
+                    shutil.copyfileobj(r, f)
+            else:  # local/NFS path "url"s work too (common on pods)
+                shutil.copyfile(url, tmp)
+            tmp.replace(path)
+
+        def cleanup(attempt, exc):
+            counters.inc("download.retries")
+            tmp.unlink(missing_ok=True)  # never leave a torn partial fetch
+
+        try:
+            retry(fetch, policy, describe=f"download {url}", on_retry=cleanup)
+        except policy.retry_on:
+            counters.inc("download.failures")
+            tmp.unlink(missing_ok=True)  # final attempt's torn partial
+            raise
     if runtime is not None:
         runtime.barrier()  # non-roots wait for the file to appear
     assert path.exists(), f"download of {url} failed"

@@ -1,0 +1,118 @@
+"""Deterministic fault-injection registry.
+
+Every resilience behavior in this package (download retry, shard
+quarantine, torn-checkpoint fallback, NaN step skip) is testable on CPU
+because its failure is *injectable* here instead of requiring a real
+flaky network or a real preempted host. A fault site is a named counter:
+code at the site asks the registry whether to fail, the registry
+decrements, and after the armed count is exhausted the site behaves
+normally — exactly the shape of a transient production fault.
+
+Arming is programmatic (``FAULTS.arm("download", 2)``) or env-driven for
+CLI/subprocess runs::
+
+    DALLE_TPU_FAULTS="download=2,shard_open=1,nan_at_step=5,ckpt_corrupt=1"
+
+Sites in use:
+
+===============  =============================================================
+``download``     ``utils.download``: the fetch raises ``URLError`` N times
+``shard_open``   ``data.webdata``: ``open_shard`` raises ``OSError`` N times
+``shard_read``   ``data.webdata``: a ``TarError`` is raised mid-shard N times
+``ckpt_corrupt`` ``utils.checkpoint``: one payload file of the just-committed
+                 step dir is corrupted after the manifest is written
+``nan_at_step``  ``parallel.step`` via the trainer: the loss is forced to NaN
+                 at global step K (value-style site: the armed count IS K)
+===============  =============================================================
+
+Injection must be impossible to leave on by accident: the registry is
+inert unless armed, ``tests/conftest.py`` asserts the env var is unset,
+and every consumed fault is tallied in ``fired`` for assertions.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+ENV_VAR = "DALLE_TPU_FAULTS"
+
+# sites whose armed number is a parameter (e.g. a step index), not a count
+# of failures to consume
+_VALUE_SITES = frozenset({"nan_at_step"})
+
+
+def _parse_spec(spec: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad {ENV_VAR} entry {part!r}: want site=count"
+            )
+        site, _, count = part.partition("=")
+        out[site.strip()] = int(count)
+    return out
+
+
+class FaultRegistry:
+    """Named, counted injection points. Thread-safe (loaders prefetch in
+    background threads)."""
+
+    def __init__(self, spec: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._armed: Dict[str, int] = {}
+        self.fired: Dict[str, int] = {}
+        if spec:
+            self.configure(spec)
+
+    # ----------------------------------------------------------- arming
+    def configure(self, spec: str) -> None:
+        """Arm from a ``site=count,...`` spec (the env-var format)."""
+        for site, count in _parse_spec(spec).items():
+            self.arm(site, count)
+
+    def arm(self, site: str, count: int = 1) -> None:
+        with self._lock:
+            self._armed[site] = count
+
+    def reset(self) -> None:
+        with self._lock:
+            self._armed.clear()
+            self.fired.clear()
+
+    # ---------------------------------------------------------- querying
+    def active(self) -> bool:
+        with self._lock:
+            return any(v > 0 or k in _VALUE_SITES for k, v in self._armed.items())
+
+    def value(self, site: str) -> Optional[int]:
+        """Parameter-style read (e.g. ``nan_at_step`` -> the step index);
+        does not consume. None when the site is unarmed."""
+        with self._lock:
+            return self._armed.get(site)
+
+    def take(self, site: str) -> bool:
+        """Consume one armed failure at ``site``. True exactly ``count``
+        times after ``arm(site, count)``, then False forever."""
+        with self._lock:
+            remaining = self._armed.get(site, 0)
+            if site in _VALUE_SITES or remaining <= 0:
+                return False
+            self._armed[site] = remaining - 1
+            self.fired[site] = self.fired.get(site, 0) + 1
+            return True
+
+    def maybe_raise(self, site: str, exc: Exception) -> None:
+        """Raise ``exc`` if a failure is armed at ``site`` (consuming it)."""
+        if self.take(site):
+            raise exc
+
+
+# process-wide registry; env spec is read once at import so CLI subprocesses
+# (the e2e tests drive real CLIs) inherit armed faults through the
+# environment without any plumbing
+FAULTS = FaultRegistry(os.environ.get(ENV_VAR))

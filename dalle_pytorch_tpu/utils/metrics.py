@@ -11,8 +11,46 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
-from typing import Any, Optional
+from typing import Any, Dict, Optional
+
+
+class Counters:
+    """Process-wide named counters for fault accounting (docs/DESIGN.md §9).
+
+    Data-path degradation (skipped samples, quarantined shards, download
+    retries) must be COUNTED, not just warned about — a run that silently
+    dropped 30% of its shards looks healthy in the loss curve. Producers
+    (data/webdata.py, utils/download.py) ``inc`` from loader threads;
+    the trainer snapshots into the step metrics. Thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+            return self._counts[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self, prefix: str = "") -> Dict[str, int]:
+        with self._lock:
+            return {
+                k: v for k, v in sorted(self._counts.items())
+                if k.startswith(prefix)
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+counters = Counters()
 
 
 class MetricsLogger:
@@ -63,6 +101,12 @@ class MetricsLogger:
     def log_text(self, text: str) -> None:
         if self.enabled:
             print(text, flush=True)
+
+    def log_counters(self, step: Optional[int] = None, prefix: str = "") -> None:
+        """Emit the named fault counters (nonzero only) as metrics."""
+        snap = {k: v for k, v in counters.snapshot(prefix).items() if v}
+        if snap:
+            self.log(snap, step=step)
 
     def log_images(self, name: str, images, step: Optional[int] = None, captions=None):
         """images: (b, h, w, 3) float in [0,1]; saved to wandb when active."""

@@ -1,0 +1,202 @@
+"""Resilience layer: retry policies, preemption handling, and directory
+manifests — the host-side half of fault tolerance (the device-side half,
+the NaN step guard, lives in ``parallel/step.py``).
+
+Failure model (docs/DESIGN.md §9): on preemptible TPU pods the faults
+that actually occur are (a) host preemption mid-epoch (SIGTERM with a
+short grace window), (b) torn checkpoint dirs from a crash mid-save,
+(c) transient network failures on downloads and shard streams, and
+(d) non-finite losses from numerics or bad batches. Each gets one
+mechanism here, each injectable via ``utils.faults`` so tests exercise
+the real code path deterministically on CPU.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import signal
+import sys
+import time
+from dataclasses import dataclass, field, replace
+from pathlib import Path
+from typing import Callable, Optional, Tuple, Type
+
+MANIFEST_NAME = "MANIFEST.json"
+COMMIT_NAME = "COMMITTED"
+
+
+# --------------------------------------------------------------- retry
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff with full jitter: attempt i (0-based) sleeps
+    ``min(max_delay, base_delay * 2**i) * uniform(1-jitter, 1)``."""
+
+    attempts: int = 3
+    base_delay: float = 0.5
+    max_delay: float = 30.0
+    jitter: float = 0.5
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,)
+
+    def from_env(self, prefix: str) -> "RetryPolicy":
+        """Override attempts/base_delay from ``<PREFIX>_RETRIES`` /
+        ``<PREFIX>_BACKOFF`` (operators tune retry budgets per deployment
+        without code changes; docs/DESIGN.md §9 lists the knobs)."""
+        out = self
+        retries = os.environ.get(f"{prefix}_RETRIES")
+        if retries is not None:
+            out = replace(out, attempts=int(retries))
+        backoff = os.environ.get(f"{prefix}_BACKOFF")
+        if backoff is not None:
+            out = replace(out, base_delay=float(backoff))
+        return out
+
+
+def retry(
+    fn: Callable,
+    policy: RetryPolicy = RetryPolicy(),
+    describe: str = "",
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+):
+    """Call ``fn()`` up to ``policy.attempts`` times; re-raise the last
+    error once exhausted. ``on_retry(attempt, exc)`` runs before each
+    backoff — i.e. only when another attempt follows, so it counts actual
+    retries; final-failure cleanup belongs in the caller's except. ``sleep``
+    and ``rng`` are injectable so tests assert the backoff schedule
+    without wall-clock waits."""
+    rng = rng or random.Random()
+    attempts = max(1, policy.attempts)  # "0 retries" still means one attempt
+    last: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except policy.retry_on as e:
+            last = e
+            if attempt == attempts - 1:
+                break
+            if on_retry is not None:
+                on_retry(attempt, e)
+            delay = min(policy.max_delay, policy.base_delay * (2 ** attempt))
+            delay *= 1.0 - policy.jitter * rng.random()
+            print(
+                f"retry {attempt + 1}/{attempts} "
+                f"{describe or getattr(fn, '__name__', 'call')}: "
+                f"{type(e).__name__}: {e} (backoff {delay:.2f}s)",
+                file=sys.stderr,
+            )
+            if delay > 0:
+                sleep(delay)
+    assert last is not None
+    raise last
+
+
+# ---------------------------------------------------------- preemption
+
+
+class PreemptionHandler:
+    """Convert SIGTERM/SIGINT into a flag the training loop polls.
+
+    Preemptible TPU hosts get SIGTERM with a short grace window; the loop
+    finishes the in-flight step, writes an emergency step-granular
+    checkpoint, and exits cleanly (train_dalle.py). The first signal only
+    sets the flag; a second raises ``KeyboardInterrupt`` so a stuck save
+    can still be interrupted by hand. Use as a context manager —
+    original handlers are restored on exit."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = signals
+        self.triggered = False
+        self.signum: Optional[int] = None
+        self._old = {}
+
+    def _handle(self, signum, frame):
+        if self.triggered:
+            raise KeyboardInterrupt(f"second signal {signum} during shutdown")
+        self.triggered = True
+        self.signum = signum
+        print(
+            f"signal {signum} received: finishing step, saving emergency "
+            "checkpoint, exiting",
+            file=sys.stderr,
+        )
+
+    def __enter__(self) -> "PreemptionHandler":
+        for s in self.signals:
+            self._old[s] = signal.signal(s, self._handle)
+        return self
+
+    def __exit__(self, *exc):
+        for s, old in self._old.items():
+            signal.signal(s, old)
+        self._old.clear()
+        return False
+
+
+# --------------------------------------------------- directory manifests
+
+
+def _sha256(path: Path, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def write_dir_manifest(dirpath: str, extra: Optional[dict] = None) -> None:
+    """Checksum every file under ``dirpath`` into MANIFEST.json, then
+    write the COMMITTED marker (atomically, last) — the two-phase commit
+    for directory checkpoints. A crash at ANY point leaves either no
+    marker (torn save, skipped by readers) or a fully verifiable dir."""
+    root = Path(dirpath)
+    files = {}
+    for p in sorted(root.rglob("*")):
+        if not p.is_file() or p.name in (MANIFEST_NAME, COMMIT_NAME):
+            continue
+        rel = p.relative_to(root).as_posix()
+        files[rel] = {"sha256": _sha256(p), "bytes": p.stat().st_size}
+    manifest = {"files": files, **(extra or {})}
+    mpath = root / MANIFEST_NAME
+    tmp = mpath.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(manifest, indent=1, sort_keys=True))
+    tmp.replace(mpath)
+    ctmp = root / (COMMIT_NAME + ".tmp")
+    ctmp.write_text("ok\n")
+    ctmp.replace(root / COMMIT_NAME)
+
+
+def verify_dir_manifest(dirpath: str) -> Tuple[bool, str]:
+    """-> (ok, reason). Unverified means: no commit marker (torn save),
+    no/unreadable manifest, a listed file missing, size drift, or a
+    checksum mismatch (bit corruption). Extra unlisted files are allowed
+    (a writer may leave scratch); everything the manifest names must
+    verify."""
+    root = Path(dirpath)
+    if not (root / COMMIT_NAME).exists():
+        return False, "no commit marker (torn or in-progress save)"
+    mpath = root / MANIFEST_NAME
+    if not mpath.exists():
+        return False, "commit marker without manifest"
+    try:
+        manifest = json.loads(mpath.read_text())
+        files = manifest["files"]
+    except (ValueError, KeyError) as e:
+        return False, f"unreadable manifest: {e}"
+    for rel, spec in files.items():
+        p = root / rel
+        if not p.exists():
+            return False, f"missing file {rel}"
+        if p.stat().st_size != spec["bytes"]:
+            return False, f"size mismatch {rel}"
+        if _sha256(p) != spec["sha256"]:
+            return False, f"checksum mismatch {rel}"
+    return True, "ok"

@@ -53,6 +53,29 @@ assert jax.local_device_count() == 8, (
     f"expected 8 virtual CPU devices, got {jax.devices()}"
 )
 
+# fault injection must be OFF unless a test arms it explicitly — an armed
+# env var would silently poison every download/shard/checkpoint test
+assert not os.environ.get("DALLE_TPU_FAULTS"), (
+    f"DALLE_TPU_FAULTS={os.environ['DALLE_TPU_FAULTS']!r} is set; the test "
+    "suite requires fault injection off (tests arm FAULTS programmatically)"
+)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_resilience_registries():
+    """Keep the process-wide fault registry and fault counters hermetic:
+    a test that arms faults or trips counters must not leak into the next."""
+    from dalle_pytorch_tpu.utils.faults import FAULTS
+    from dalle_pytorch_tpu.utils.metrics import counters
+
+    FAULTS.reset()
+    counters.reset()
+    yield
+    FAULTS.reset()
+    counters.reset()
+
 
 def pytest_collection_modifyitems(config, items):
     """Data-driven slow tier: tests listed in tests/slow_tests.txt (measured

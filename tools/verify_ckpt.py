@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Standalone checkpoint-directory verifier.
+
+Walks every ``step_*`` dir under a sharded checkpoint root and reports its
+verification state (commit marker + per-file sha256 manifest — the format
+``save_sharded_checkpoint`` writes, docs/DESIGN.md §9). This is what the
+trainer's resume probe runs implicitly; operators run it by hand before
+relying on a checkpoint, e.g. ahead of deleting an older known-good one::
+
+    python tools/verify_ckpt.py dalle-cp
+    python tools/verify_ckpt.py dalle-cp --step 1200
+
+Exit status: 0 when every step dir verifies, 1 when any is torn/corrupt
+(the report names the failing file and reason), 2 when none verifies —
+the trainer would refuse to resume from this directory.
+
+Imports only the manifest helpers (no jax/orbax), so it runs anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from dalle_pytorch_tpu.utils.resilience import verify_dir_manifest  # noqa: E402
+
+
+def verify_root(ckpt_dir: str, step: int | None = None) -> int:
+    root = Path(ckpt_dir)
+    if step is not None:
+        dirs = [root / f"step_{step:08d}"]
+        if not dirs[0].is_dir():
+            print(f"FAIL  {dirs[0]}: no such step dir")
+            return 2
+    else:
+        dirs = sorted(root.glob("step_*"))
+        if not dirs:
+            print(f"FAIL  {root}: no step_* dirs")
+            return 2
+
+    newest_verified = None
+    bad = 0
+    for d in dirs:
+        ok, reason = verify_dir_manifest(d)
+        if ok:
+            manifest = json.loads((d / "MANIFEST.json").read_text())
+            n = len(manifest.get("files", {}))
+            meta = manifest.get("meta") or {}
+            tag = " emergency" if meta.get("emergency") else ""
+            print(f"OK    {d.name}  ({n} files verified{tag})")
+            newest_verified = d.name
+        else:
+            print(f"FAIL  {d.name}: {reason}")
+            bad += 1
+
+    if newest_verified is None:
+        print(f"no verified checkpoint under {root} — resume would refuse")
+        return 2
+    print(f"newest verified: {newest_verified}" +
+          (f"  ({bad} torn/corrupt dir(s) would be skipped)" if bad else ""))
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("ckpt_dir", help="sharded checkpoint root (the <name>-cp dir)")
+    ap.add_argument("--step", type=int, default=None,
+                    help="verify only this step")
+    args = ap.parse_args(argv)
+    return verify_root(args.ckpt_dir, args.step)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

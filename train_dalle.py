@@ -16,6 +16,8 @@ Differences from the reference, by design:
 """
 
 import argparse
+import math
+import sys
 import time
 from pathlib import Path
 
@@ -114,6 +116,19 @@ def build_parser():
     train_group.add_argument("--lr_decay", action="store_true")
     train_group.add_argument("--sharded_ckpt", action="store_true",
                              help="also write orbax sharded checkpoints (multi-host scale)")
+    train_group.add_argument("--no_auto_resume", dest="auto_resume",
+                             action="store_false",
+                             help="don't auto-resume from a verified "
+                                  "<name>-cp step dir (by default a "
+                                  "preempted run relaunched with the SAME "
+                                  "command picks up where it stopped; a "
+                                  "NEW experiment should use a fresh "
+                                  "--dalle_output_file_name or this flag)")
+    train_group.add_argument("--nan_abort_after", default=5, type=int,
+                             help="abort after this many CONSECUTIVE "
+                                  "non-finite steps (each is skipped on "
+                                  "device and the batch retried; a "
+                                  "persistent NaN means the run is dead)")
     train_group.add_argument("--profile_trace_dir", default=None, type=str,
                              help="capture a jax.profiler trace (viewable in "
                                   "TensorBoard/XProf) around --profile_step; "
@@ -185,10 +200,15 @@ def main():
         make_train_step,
     )
     from dalle_pytorch_tpu.utils import (
+        FAULTS,
         MetricsLogger,
+        PreemptionHandler,
         ReduceLROnPlateau,
         ConstantLR,
         Throughput,
+        counters,
+        latest_verified_step,
+        load_sharded_checkpoint,
         save_sharded_checkpoint,
     )
 
@@ -394,7 +414,10 @@ def main():
         )
 
     step_fn = make_train_step(
-        loss_fn, optimizer, runtime, shardings, dynamic_lr=True
+        loss_fn, optimizer, runtime, shardings, dynamic_lr=True,
+        # nan_at_step is the fault-harness hook (utils/faults.py): forces
+        # one NaN loss at step K inside the jitted step; None in production
+        nan_inject_step=FAULTS.value("nan_at_step"),
     )
 
     sched = (
@@ -407,6 +430,61 @@ def main():
     lr = sched.lr
 
     ckpt_path = f"{args.dalle_output_file_name}.ckpt"
+    sharded_dir = f"{args.dalle_output_file_name}-cp"
+
+    # ---- step-granular resume (preemption recovery) ----------------------
+    # A verified step dir under <name>-cp (periodic --sharded_ckpt save or a
+    # previous run's emergency save) resumes params+opt+step exactly where
+    # the preempted run stopped — load_sharded_checkpoint skips torn/corrupt
+    # dirs and falls back to the newest verified one.
+    resume_epoch = resume_iter = -1
+    global_step = 0
+    verified = None
+    if args.auto_resume:
+        # probe (full checksum pass) on one host; N hosts hashing the same
+        # multi-GB dir on shared storage would multiply relaunch I/O
+        if jax.process_index() == 0:
+            verified = latest_verified_step(sharded_dir)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            verified = int(multihost_utils.broadcast_one_to_all(
+                np.int32(-1 if verified is None else verified)
+            ))
+            verified = None if verified < 0 else verified
+    if verified is not None:
+        # state itself is the shape/dtype template — the shardings path
+        # never reads values, and np.asarray-ing a pod-sharded state would
+        # gather (or crash on non-addressable shards). verify=False: the
+        # probe just hashed this exact dir
+        state, smeta, global_step = load_sharded_checkpoint(
+            sharded_dir, state, step=verified, shardings=shardings,
+            verify=False,
+        )
+        resume_epoch = int(smeta.get("epoch", -1))
+        resume_iter = int(smeta.get("iter", -1))
+        if smeta.get("scheduler_state"):
+            sched.load_state_dict(smeta["scheduler_state"])
+            lr = sched.lr
+        if resume_epoch >= 0:
+            start_epoch = resume_epoch
+        logger.log_text(
+            f"resuming from {sharded_dir} step {global_step} "
+            f"(epoch {resume_epoch}, iter {resume_iter})"
+        )
+        # batch-skip replay needs a loader whose per-epoch order is
+        # reproducible in a fresh process (the folder DataLoader reshuffles
+        # from seed+epoch). Tar streams advance a sequential rng across
+        # epochs, so skipping indices would drop/duplicate samples — replay
+        # the partial epoch from its start instead (duplication is the safe
+        # direction) and say so.
+        if resume_iter >= 0 and not hasattr(loader, "epoch"):
+            logger.log_text(
+                "tar-stream loader has no reproducible epoch order: "
+                f"replaying epoch {resume_epoch} from its start "
+                f"(up to {resume_iter + 1} batches re-seen)"
+            )
+            resume_iter = -1
 
     def save(epoch):
         # gather is a collective — every process participates; only the
@@ -421,97 +499,226 @@ def main():
             opt_state=host_opt, step=int(state.step),
         )
 
-    def save_sharded(step):
-        if args.sharded_ckpt:
-            save_sharded_checkpoint(
-                f"{args.dalle_output_file_name}-cp", step, state,
-                meta={"epoch": epoch}, keep_n=args.keep_n_checkpoints,
-            )
+    def save_sharded(step, epoch, it, emergency=False):
+        # step-granular, verified (manifest + commit marker): the resume
+        # probe above restores exactly this. Collective — every host writes
+        # its addressable shards.
+        save_sharded_checkpoint(
+            sharded_dir, step, state,
+            meta={
+                "epoch": epoch, "iter": it,
+                "scheduler_state": sched.state_dict(),
+                "emergency": emergency,
+            },
+            keep_n=args.keep_n_checkpoints,
+        )
 
     # pre-flight save: fail early when misconfigured (train_dalle.py:561-563)
     save(start_epoch - 1)
 
     throughput = Throughput(window=10)
-    global_step = 0
     prev_loss = None
     tracing = False
-    for epoch in range(start_epoch, args.epochs):
-        for i, batch in enumerate(loader):
-            image_tokens = vae_encode(batch["image"])
-            train_batch = {
-                "text": jnp.asarray(batch["text"]),
-                "image": image_tokens,
-            }
-            if args.profile_trace_dir is not None and runtime.is_root_worker():
-                # trace a steady-state window: block so compilation and the
-                # profiled steps don't overlap in the capture
-                if global_step == args.profile_step:
-                    jax.block_until_ready(state.params)
-                    jax.profiler.start_trace(args.profile_trace_dir)
-                    tracing = True
-                elif global_step == args.profile_step + 3:
-                    jax.block_until_ready(state.params)
-                    jax.profiler.stop_trace()
-                    tracing = False
-                    logger.log_text(
-                        f"profiler trace for steps "
-                        f"{args.profile_step}..{args.profile_step + 2} "
-                        f"written to {args.profile_trace_dir}"
-                    )
+    # applied_steps keys the step rng by BATCH, not by dispatch attempt: a
+    # batch retried after a NaN skip reuses its key, so a recovered run's
+    # update sequence matches an unfaulted run's exactly
+    applied_steps = global_step - int(state.skipped)
+    nan_run = 0
+    last_fed = None  # (i, batch) of the most recent dispatch, for retry
+    retry_batch = None
 
-            state, loss = step_fn(
-                state, train_batch, jax.random.key(global_step), jnp.asarray(lr)
+    def process_verdict():
+        # Read the most recent dispatched step's loss. This DOES wait for
+        # that step to finish — the price of the retry-on-skip contract
+        # (the next batch choice depends on this outcome); the loop
+        # overlaps what it can by prefetching the next batch before
+        # calling this. Called at the loop head AND before every
+        # checkpoint save, so saved scheduler state and consumed-batch
+        # metadata always reflect the in-flight step's outcome. The loss
+        # is NaN for ANY device-rejected step (parallel/step.py), grads
+        # included.
+        nonlocal prev_loss, nan_run, applied_steps, lr, retry_batch
+        if prev_loss is None:
+            return
+        if math.isfinite(float(prev_loss)):
+            nan_run = 0
+            applied_steps += 1
+            lr = sched.step(float(prev_loss))
+        else:
+            # the device already rejected the update (parallel/step.py
+            # nan_guard); retry the batch — a transient NaN costs one
+            # step, a persistent one trips the consecutive-skip abort.
+            # The device-side counter is the source of truth: it includes
+            # skips from before a resume.
+            nan_run = int(state.consec_skipped)
+            counters.inc("train.nan_skips")
+            logger.log_text(
+                f"step {global_step - 1}: non-finite loss — "
+                f"update skipped on device, retrying batch "
+                f"({nan_run}/{args.nan_abort_after})"
             )
-
-            # plateau scheduler steps every iteration, like the reference's
-            # sched.step(avg_loss) (train_dalle.py:628-633) — but on the
-            # PREVIOUS step's loss, which has already materialized, so the
-            # host never blocks on the just-dispatched step (a same-step
-            # float(loss) would serialize host and device every iteration)
-            if prev_loss is not None:
-                lr = sched.step(float(prev_loss))
-            prev_loss = loss
-
-            if global_step % 10 == 0:
-                logger.log(
-                    {"loss": float(loss), "epoch": epoch, "iter": i, "lr": lr},
-                    step=global_step,
+            if nan_run >= args.nan_abort_after:
+                # the rejected batch's update is NOT in state: record
+                # its predecessor so a later resume replays it
+                save_sharded(int(state.step), epoch,
+                             last_fed[0] - 1, emergency=True)
+                logger.finish()
+                raise SystemExit(
+                    f"{nan_run} consecutive non-finite steps — "
+                    "aborting (state saved for post-mortem at "
+                    f"{sharded_dir})"
                 )
-            rate = throughput.update(args.batch_size)
-            if rate is not None:
-                logger.log({"sample_per_sec": rate}, step=global_step)
+            retry_batch = last_fed
+        prev_loss = None
 
-            if global_step > 0 and global_step % args.save_every_n_steps == 0:
-                save(epoch)
-                save_sharded(global_step)
+    with PreemptionHandler() as preempt:
+        for epoch in range(start_epoch, args.epochs):
+            if hasattr(loader, "epoch"):
+                loader.epoch = epoch  # keep shuffle order aligned on resume
+            retry_batch = None
+            nxt = None
+            exhausted = False
+            batches = enumerate(loader)
+            while True:
+                # prefetch the next candidate BEFORE blocking on the
+                # in-flight step's verdict, so host-side batch dequeue
+                # overlaps the device finishing the step. The verdict read
+                # itself is a genuine sync point: the retry-on-skip
+                # contract (bit-identical recovery) needs step N's outcome
+                # before choosing step N+1's input, so the dispatch
+                # pipeline is one deep by design — only batch prep
+                # overlaps. (Exhaustion doesn't end the epoch yet: the
+                # final dispatch's verdict may still demand a retry.)
+                while nxt is None and not exhausted:
+                    try:
+                        cand = next(batches)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    if epoch == resume_epoch and cand[0] <= resume_iter:
+                        continue  # consumed before the preemption
+                    nxt = cand
 
-            if global_step > 0 and global_step % args.sample_every_n_steps == 0:
-                # sampling over sharded params is collective: all processes
-                # run it; only the root writes the image
-                images = generate_images(
-                    dalle, state.params, vae, {"params": vae_params},
-                    train_batch["text"][:1], jax.random.key(global_step),
+                process_verdict()
+
+                if retry_batch is not None:
+                    i, batch = retry_batch
+                    retry_batch = None  # a prefetched nxt stays stashed
+                elif nxt is not None:
+                    i, batch = nxt
+                    nxt = None
+                else:
+                    break
+                last_fed = (i, batch)
+
+                image_tokens = vae_encode(batch["image"])
+                train_batch = {
+                    "text": jnp.asarray(batch["text"]),
+                    "image": image_tokens,
+                }
+                if args.profile_trace_dir is not None and runtime.is_root_worker():
+                    # trace a steady-state window: block so compilation and
+                    # the profiled steps don't overlap in the capture
+                    if global_step == args.profile_step:
+                        jax.block_until_ready(state.params)
+                        jax.profiler.start_trace(args.profile_trace_dir)
+                        tracing = True
+                    elif global_step == args.profile_step + 3:
+                        jax.block_until_ready(state.params)
+                        jax.profiler.stop_trace()
+                        tracing = False
+                        logger.log_text(
+                            f"profiler trace for steps "
+                            f"{args.profile_step}..{args.profile_step + 2} "
+                            f"written to {args.profile_trace_dir}"
+                        )
+
+                state, loss = step_fn(
+                    state, train_batch, jax.random.key(applied_steps),
+                    jnp.asarray(lr),
                 )
-                if runtime.is_root_worker():
-                    from PIL import Image
+                prev_loss = loss
 
-                    from dalle_pytorch_tpu.models.vae import denormalize
+                if global_step % 10 == 0:
+                    logger.log(
+                        {"loss": float(loss), "epoch": epoch, "iter": i,
+                         "lr": lr, "nan_skips": counters.get("train.nan_skips")},
+                        step=global_step,
+                    )
+                if global_step % 100 == 0:
+                    # data-path fault accounting
+                    logger.log_counters(step=global_step, prefix="webdata.")
+                    logger.log_counters(step=global_step, prefix="download.")
+                rate = throughput.update(args.batch_size)
+                if rate is not None:
+                    logger.log({"sample_per_sec": rate}, step=global_step)
 
-                    out = Path("dalle_samples")
-                    out.mkdir(exist_ok=True)
-                    pix = denormalize(images, getattr(vae, "normalization", None))
-                    arr = (pix[0] * 255).astype(np.uint8)
-                    Image.fromarray(arr).save(out / f"sample_{global_step:07d}.png")
-                    logger.log_images("samples", pix, step=global_step)
+                if global_step > 0 and global_step % args.save_every_n_steps == 0:
+                    # resolve the in-flight step first: the saved scheduler
+                    # state must include its loss, and a device-rejected
+                    # batch (retry_batch set) is absent from the saved
+                    # state, so resume must replay it
+                    process_verdict()
+                    save(epoch)
+                    if args.sharded_ckpt:
+                        # int(state.step) = dispatched attempts: resume
+                        # numbers its next step correctly (global_step here
+                        # is pre-increment)
+                        it = i - 1 if retry_batch is not None else i
+                        save_sharded(int(state.step), epoch, it)
 
-            global_step += 1
+                if global_step > 0 and global_step % args.sample_every_n_steps == 0:
+                    # sampling over sharded params is collective: all
+                    # processes run it; only the root writes the image
+                    images = generate_images(
+                        dalle, state.params, vae, {"params": vae_params},
+                        train_batch["text"][:1], jax.random.key(global_step),
+                    )
+                    if runtime.is_root_worker():
+                        from PIL import Image
 
-        save(epoch)
-        save_sharded(global_step)
-        # per-epoch model artifact (reference train_dalle.py:637-649); the
-        # logger is already root-gated via enabled=
-        logger.log_artifact("trained-dalle", ckpt_path, metadata=vars(args))
-        logger.log_text(f"epoch {epoch} complete")
+                        from dalle_pytorch_tpu.models.vae import denormalize
+
+                        out = Path("dalle_samples")
+                        out.mkdir(exist_ok=True)
+                        pix = denormalize(images, getattr(vae, "normalization", None))
+                        arr = (pix[0] * 255).astype(np.uint8)
+                        Image.fromarray(arr).save(out / f"sample_{global_step:07d}.png")
+                        logger.log_images("samples", pix, step=global_step)
+
+                global_step += 1
+
+                if preempt.triggered:
+                    # SIGTERM/SIGINT (pod preemption): the in-flight step
+                    # finished above — write the emergency step-granular
+                    # checkpoint and exit cleanly; the next launch resumes
+                    # from it via the startup probe
+                    if tracing:
+                        jax.profiler.stop_trace()
+                        tracing = False
+                    # as with periodic saves: resolve the in-flight step's
+                    # verdict so scheduler state is complete and a
+                    # just-rejected batch is recorded as unconsumed (the
+                    # relaunch must replay it)
+                    process_verdict()
+                    it = i - 1 if retry_batch is not None else i
+                    save_sharded(int(state.step), epoch, it, emergency=True)
+                    logger.log_text(
+                        f"emergency checkpoint at step {global_step} "
+                        f"(epoch {epoch}, iter {i}) written to {sharded_dir}; "
+                        "exiting"
+                    )
+                    logger.finish()
+                    sys.exit(0)
+
+            save(epoch)
+            if args.sharded_ckpt:
+                # epoch fully consumed: a resume starts at the NEXT epoch
+                save_sharded(int(state.step), epoch + 1, -1)
+            # per-epoch model artifact (reference train_dalle.py:637-649);
+            # the logger is already root-gated via enabled=
+            logger.log_artifact("trained-dalle", ckpt_path, metadata=vars(args))
+            logger.log_text(f"epoch {epoch} complete")
 
     if tracing:  # training ended inside the trace window
         jax.block_until_ready(state.params)
