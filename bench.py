@@ -246,6 +246,110 @@ def bench_continuous_batching(on_cpu: bool, int8: bool = True):
     }
 
 
+def bench_serve(on_cpu: bool, int8: bool = True, seed: int = 0):
+    """--serve: drive the continuous-batching engine (serving/engine.py)
+    with a synthetic Poisson-ish arrival trace (seeded exponential
+    inter-arrivals — deterministic offered load, real wall-clock service)
+    and record the REQUEST-level metrics the one-shot throughput sections
+    cannot see: p50/p95/p99 request latency, reject/preempt/deadline
+    counts, and pool occupancy. The engine runs under a deliberately
+    tightened page budget + watermark so the record also shows how the
+    robustness machinery behaves at pressure, not just the happy path."""
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, Outcome, Request, check_accounting,
+    )
+    from dalle_pytorch_tpu.utils.metrics import counters
+
+    dalle, params, depth, fmap = _serving_model(on_cpu, int8)
+    rng = np.random.RandomState(seed)
+    n_req = 6 if on_cpu else 64
+    max_batch = 2 if on_cpu else 8
+    tokens_per = fmap * fmap
+    mean_ia = 0.05 if on_cpu else 0.2  # mean inter-arrival, seconds
+
+    cfg = EngineConfig(
+        max_batch=max_batch,
+        queue_limit=max(2, n_req // 2),  # bounded: overload can reject
+        high_watermark=0.75,
+        degraded_max_new_tokens=tokens_per,  # report-only at this load
+    )
+    engine = Engine(dalle, params, cfg)
+
+    # warm the jits outside the timed trace (compile time is not latency)
+    warm = Request(request_id="__warm__", prompt=np.zeros(TEXT_SEQ, np.int32),
+                   max_new_tokens=1, seed=0)
+    engine.submit(warm)
+    engine.run()
+
+    arrivals = np.cumsum(rng.exponential(scale=mean_ia, size=n_req))
+    prompts = rng.randint(1, NUM_TEXT, size=(n_req, TEXT_SEQ)).astype(np.int32)
+    priorities = rng.randint(0, 3, size=n_req)
+
+    c0 = {k: counters.get(f"serve.{k}") for k in
+          ("rejected", "preempted", "deadline_exceeded", "completed")}
+    occ_samples = []
+    # all times on the ENGINE's clock: deadlines are compared against
+    # engine.clock.now() inside the engine, and mixing clock epochs
+    # (perf_counter vs monotonic) is undefined across platforms
+    t0 = engine.clock.now()
+    submitted = 0
+    while True:
+        now = engine.clock.now() - t0
+        while submitted < n_req and arrivals[submitted] <= now:
+            engine.submit(Request(
+                request_id=f"req{submitted}",
+                prompt=prompts[submitted],
+                max_new_tokens=tokens_per,
+                deadline=t0 + arrivals[submitted] + (120 if on_cpu else 600),
+                priority=int(priorities[submitted]),
+                seed=seed * 7919 + submitted,
+            ))
+            submitted += 1
+        busy = engine.step()
+        occ_samples.append(engine.pool.occupancy)
+        if not busy:
+            if submitted >= n_req:
+                break
+            time.sleep(min(0.005, max(0.0, arrivals[submitted] - now)))
+    wall = engine.clock.now() - t0
+    check_accounting(engine)
+
+    done = [
+        r for r in engine.results.values()
+        if r.outcome is Outcome.COMPLETED and r.request_id != "__warm__"
+    ]
+    lat = np.asarray([r.total_latency_s for r in done]) if done else np.zeros(1)
+    delta = {k: counters.get(f"serve.{k}") - v for k, v in c0.items()}
+    return {
+        "metric": f"serve_request_latency_p50_ms_batch{max_batch}"
+                  + ("_int8" if int8 else ""),
+        "value": round(float(np.percentile(lat, 50)) * 1e3, 1),
+        "unit": "ms",
+        "vs_baseline": None,
+        "p95_ms": round(float(np.percentile(lat, 95)) * 1e3, 1),
+        "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 1),
+        "queue_p50_ms": round(float(np.percentile(
+            np.asarray([r.queue_latency_s for r in done]) if done else np.zeros(1),
+            50)) * 1e3, 1),
+        "n_requests": n_req,
+        "completed": delta["completed"],
+        "rejected": delta["rejected"],
+        "preempted": delta["preempted"],
+        "deadline_exceeded": delta["deadline_exceeded"],
+        "pool_occupancy_mean": round(float(np.mean(occ_samples)), 3),
+        "pool_occupancy_max": round(float(np.max(occ_samples)), 3),
+        "pool_pages": engine.pool.total,
+        "tokens_per_request": tokens_per,
+        "completed_tokens_per_sec": round(
+            sum(len(r.tokens) for r in done) / wall, 1
+        ),
+        "mean_interarrival_s": mean_ia,
+        "arrival_seed": seed,
+        "max_batch": max_batch,
+        "device": jax.devices()[0].device_kind,
+    }
+
+
 def model_flops_per_step(batch: int, depth: int = DEPTH) -> float:
     """Analytic fwd+bwd matmul FLOPs per train step, standard MFU convention
     (backward = 2x forward; recompute does not count)."""
@@ -885,7 +989,7 @@ def main():
     # --sweep / --ragged / --vae / --clip); no flag = the full suite,
     # headline train-MFU line LAST
     only = {f for f in ("--gen", "--patterns", "--throughput", "--sweep",
-                        "--ragged", "--vae", "--clip") if f in sys.argv}
+                        "--ragged", "--serve", "--vae", "--clip") if f in sys.argv}
     if only:
         gen_int8 = None
         if "--gen" in only:
@@ -903,6 +1007,8 @@ def main():
                 print(json.dumps(r))
         if "--ragged" in only:
             print(json.dumps(_retry(lambda: bench_continuous_batching(on_cpu))))
+        if "--serve" in only:
+            print(json.dumps(_retry(lambda: bench_serve(on_cpu))))
         if "--patterns" in only:
             for r in _retry(lambda: bench_sparse_patterns(on_cpu)):
                 print(json.dumps(r))

@@ -7,6 +7,7 @@ from .sampling import (
     generate_images,
     generate_texts,
     init_decode_cache,
+    insert_decode_cache,
     merge_decode_caches,
     set_decode_offsets,
 )
@@ -29,6 +30,7 @@ __all__ = [
     "generate_texts",
     "gumbel_softmax",
     "init_decode_cache",
+    "insert_decode_cache",
     "masked_mean",
     "merge_decode_caches",
     "set_decode_offsets",

@@ -392,9 +392,9 @@ class DALLE(nn.Module):
         ``pos`` may be a SCALAR (the whole batch at one position — the
         decode scan) or a (b,) VECTOR of per-sequence positions (ragged
         decode offsets / continuous batching). The vector form requires a
-        paged cache (per-sequence write indices, ops/attention.py) and
-        ``rotary_emb=True`` (the learned positional tables' decode path
-        slices by a single position).
+        paged cache (per-sequence write indices, ops/attention.py); with
+        learned positional tables (``rotary_emb=False``) the per-position
+        embedding lookup becomes a row gather over the (b,) positions.
 
         ``image_only`` (static) asserts pos + 1 is an image position and
         computes only the image-vocab slice of the head, returning
@@ -407,9 +407,6 @@ class DALLE(nn.Module):
         """
         b = token.shape[0]
         ragged = jnp.ndim(pos) == 1
-        assert not (ragged and not self.rotary_emb), (
-            "ragged decode offsets require rotary_emb=True"
-        )
         is_text = pos < self.text_len_internal
 
         text_tok = jnp.clip(token, 0, self.num_text_tokens_ext - 1)
@@ -422,11 +419,21 @@ class DALLE(nn.Module):
             tpos = jnp.clip(pos, 0, self.text_len_internal - 1)
             ipos = jnp.clip(pos - self.text_len_internal, 0, self.image_seq_len - 1)
             img_grid = self.image_pos_emb(self.image_seq_len)
-            emb = emb + jnp.where(
-                is_text,
-                self.text_pos_emb(tpos)[None],
-                jax.lax.dynamic_slice_in_dim(img_grid[0], ipos, 1, axis=0),
-            ).astype(emb.dtype)
+            if ragged:
+                # per-sequence positions (continuous batching): the learned
+                # tables become row gathers — (b,) indices -> (b, dim)
+                pe = jnp.where(
+                    is_text[:, None],
+                    self.text_pos_emb(tpos),
+                    jnp.take(img_grid[0], ipos, axis=0),
+                )
+            else:
+                pe = jnp.where(
+                    is_text,
+                    self.text_pos_emb(tpos)[None],
+                    jax.lax.dynamic_slice_in_dim(img_grid[0], ipos, 1, axis=0),
+                )
+            emb = emb + pe.astype(emb.dtype)
 
         x = emb[:, None, :].astype(self.dtype)
         out = self.transformer(

@@ -136,6 +136,42 @@ def merge_decode_caches(caches):
     return jax.tree_util.tree_map(merge, *caches)
 
 
+def insert_decode_cache(batched, sub, slot: int):
+    """Write a batch-1 PAGED decode cache into row ``slot`` of a batched
+    cache — the fixed-slot admission primitive of the serving engine
+    (serving/engine.py): a newly-prefilled request lands in a free slot of
+    the running batch without rebuilding the whole cache the way
+    ``merge_decode_caches`` does.
+
+    Both trees must be fully vectorized (every per-position index a (b,)
+    vector — run ``set_decode_offsets`` on each after init/prefill), so
+    every leaf pairs as ``batched[slot] = sub[0]``. Returns the updated
+    batched cache; the previous tenant's rows are fully overwritten (K/V
+    pools, page table, indices, shift history), which is what makes a slot
+    reset = inserting a pristine cache."""
+    sub_leaves = jax.tree_util.tree_leaves_with_path(sub)
+    keys = {getattr(p[-1], "key", None) for p, _ in sub_leaves}
+    if "cached_key" in keys:
+        raise ValueError("insert_decode_cache requires paged caches")
+    if "gate_index" in keys:
+        raise ValueError(
+            "insert_decode_cache cannot place gMLP ('mlp') caches: the "
+            "spatial-gate history indexes by a scalar absolute position"
+        )
+    for p, x in sub_leaves:
+        if x.ndim == 0 or x.shape[0] != 1:
+            raise ValueError(
+                f"sub-cache leaf {p} is not batch-1-vectorized "
+                f"(shape {getattr(x, 'shape', ())}); run set_decode_offsets "
+                "on the prefilled cache first"
+            )
+
+    def fn(b_leaf, s_leaf):
+        return b_leaf.at[slot].set(s_leaf[0])
+
+    return jax.tree_util.tree_map(fn, batched, sub)
+
+
 @partial(jax.jit, static_argnums=(0, 5, 8, 9, 10, 11))
 def decode_tokens(
     dalle: DALLE,

@@ -58,6 +58,22 @@ FORMATS = ("paged", "flat", "4d")
 
 DEFAULT_PAGE_SIZE = 128
 
+
+class InvalidKVFormatError(ValueError):
+    """Raised at POLICY-RESOLUTION time for an unknown cache format (from
+    ``DALLE_TPU_KV_FORMAT``, legacy ``DALLE_TPU_FLAT_KV``, or an explicit
+    ``cache_format=`` argument) — a bad override must fail here, naming the
+    valid formats, not as a shape error deep inside cache init. Subclasses
+    ValueError so pre-existing ``except ValueError`` callers keep working."""
+
+    def __init__(self, source: str, got: object, valid: tuple = FORMATS):
+        super().__init__(
+            f"{source} must be one of {valid}, got {got!r}"
+        )
+        self.source = source
+        self.got = got
+        self.valid = valid
+
 # every (format, batch, reason) decision made this process, in order — the
 # observable record bench.py attaches to its throughput entries
 CHOICE_LOG: list = []
@@ -91,7 +107,7 @@ def format_override(fmt: Optional[str]) -> Iterator[None]:
     the format participates in the jit cache key as a static argument
     rather than as hidden module state)."""
     if fmt is not None and fmt not in FORMATS:
-        raise ValueError(f"cache_format must be one of {FORMATS}, got {fmt!r}")
+        raise InvalidKVFormatError("cache_format", fmt)
     token = _OVERRIDE.set(fmt)
     try:
         yield
@@ -120,14 +136,12 @@ def choose_cache_format(batch: int) -> str:
         legacy = os.environ.get("DALLE_TPU_FLAT_KV")
         if env not in (None, ""):
             if env not in FORMATS:
-                raise ValueError(
-                    f"DALLE_TPU_KV_FORMAT must be one of {FORMATS}, got {env!r}"
-                )
+                raise InvalidKVFormatError("DALLE_TPU_KV_FORMAT", env)
             fmt, reason = env, "DALLE_TPU_KV_FORMAT"
         elif legacy not in (None, ""):
             if legacy not in ("0", "1"):
-                raise ValueError(
-                    f"DALLE_TPU_FLAT_KV must be '0' or '1', got {legacy!r}"
+                raise InvalidKVFormatError(
+                    "DALLE_TPU_FLAT_KV", legacy, valid=("0", "1")
                 )
             fmt, reason = ("flat" if legacy == "1" else "4d"), "DALLE_TPU_FLAT_KV"
         elif batch == 1:
@@ -145,9 +159,7 @@ def resolve_format(cache_format: Optional[str], batch: int) -> str:
     policy. Entry point for models/sampling.py."""
     if cache_format is not None:
         if cache_format not in FORMATS:
-            raise ValueError(
-                f"cache_format must be one of {FORMATS}, got {cache_format!r}"
-            )
+            raise InvalidKVFormatError("cache_format", cache_format)
         _emit(cache_format, batch, "cache_format argument")
         return cache_format
     return choose_cache_format(batch)

@@ -126,6 +126,29 @@ def append(
     return pool.at[bidx, phys, off].set(rows, mode="drop")
 
 
+def reset_rows(pool: jnp.ndarray, rows) -> jnp.ndarray:
+    """Zero the page pools of the given batch rows — the eviction reset.
+
+    A preempted/completed request's pages must not leak stale K/V into the
+    slot's next tenant: the serving engine re-prefills the slot from scratch,
+    and prefill only overwrites positions [0, T), so stale rows beyond the
+    new request's frontier would otherwise survive under the (zeros-masked)
+    attention sweep contract. ``rows`` is an int row index or a sequence of
+    them; works on any (b, ...) pool-shaped leaf."""
+    return pool.at[jnp.asarray(rows)].set(0)
+
+
+def reset_table_rows(table: jnp.ndarray, rows) -> jnp.ndarray:
+    """Restore the identity mapping for the given batch rows of a page
+    table. Eviction hands the slot's physical pages back as a pristine
+    identity-mapped pool (the invariant every in-jit user keeps — see
+    ``identity_table``); a serving layer doing cross-slot page remapping
+    would manage its own tables instead."""
+    b, n_p = table.shape
+    ident = jnp.arange(n_p, dtype=table.dtype)
+    return table.at[jnp.asarray(rows)].set(ident)
+
+
 def gather(pool: jnp.ndarray, table: jnp.ndarray, variant=None) -> jnp.ndarray:
     """Assemble the logical cache view (b, n_pages * page, feat) from the
     paged pool. The ``take`` variant is the production path (the row gather

@@ -1,0 +1,31 @@
+"""Continuous-batching serving subsystem: request lifecycle, admission
+control, page-pool pressure handling. See engine.py for the architecture
+and docs/DESIGN.md for the failure model."""
+
+from .engine import Engine, EngineConfig, check_accounting
+from .scheduler import PagePool, Scheduler, pages_for
+from .types import (
+    Clock,
+    EngineUnsupportedModel,
+    FakeClock,
+    Outcome,
+    RejectReason,
+    Request,
+    RequestResult,
+)
+
+__all__ = [
+    "Clock",
+    "Engine",
+    "EngineConfig",
+    "EngineUnsupportedModel",
+    "FakeClock",
+    "Outcome",
+    "PagePool",
+    "RejectReason",
+    "Request",
+    "RequestResult",
+    "Scheduler",
+    "check_accounting",
+    "pages_for",
+]

@@ -1,0 +1,575 @@
+"""Continuous-batching serving engine over the paged KV cache.
+
+The request lifecycle (docs/DESIGN.md, serving failure model):
+
+    submit -> [rejected] | queued -> admitted (prefill, slot insert)
+           -> decoding (one vector-position decode_step per iteration)
+           -> completed | deadline_exceeded | cancelled
+           -> (page exhaustion) evicted -> requeued (aged) -> ... -> preempt_cap
+
+Composition of the PR-1/PR-2 primitives: the engine owns ONE batched paged
+decode cache of ``max_batch`` fixed slots (every index leaf vectorized via
+``set_decode_offsets``), prefills each admitted request alone (batch-1) and
+lands it in a free slot with ``insert_decode_cache`` — the
+admit-mid-flight shape of Ragged Paged Attention serving (PAPERS.md) — and
+steps all active slots with a single jitted vector-position
+``DALLE.decode_step``. Faults (``utils/faults.py`` sites ``page_exhaust``,
+``prefill_fail``, ``decode_stall``, ``request_cancel``) make every failure
+path deterministic on CPU.
+
+Determinism contract (pinned by tests/test_serving.py): a request's token
+at internal position p is sampled with ``fold_in(key(seed), p)``, and all
+decode math is row-independent at fixed batch width (the jitted step always
+runs the full ``max_batch``; inactive slots compute garbage that is
+discarded, never read cross-row). Re-running an evicted request therefore
+reproduces its tokens bit-identically — preemption costs work, never
+changes output.
+
+Throughput note: this loop dispatches one jitted step per generated token
+(a host decision point between steps is the price of admission control,
+deadlines, and preemption). Single-shot batch generation without a request
+lifecycle should keep using ``models/sampling.py``'s fused scan — the CLI
+(generate.py) routes through THIS engine so serving behavior is exercised
+end-to-end, and falls back to the scan only for engine-unsupported models.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.dalle import DALLE, top_k_filter
+from ..models.sampling import (
+    init_decode_cache,
+    insert_decode_cache,
+    set_decode_offsets,
+)
+from ..ops import kv_policy, paged_kv
+from ..utils.faults import FAULTS
+from ..utils.metrics import counters, gauges
+from .scheduler import Entry, PagePool, Scheduler, pages_for
+from .types import (
+    Clock,
+    EngineUnsupportedModel,
+    Outcome,
+    RejectReason,
+    Request,
+    RequestResult,
+)
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Operator knobs. Defaults are deliberately permissive (pool = full
+    physical capacity, no degradation pressure) so a bare engine behaves
+    like plain batched decode; tests and bench tighten them to create
+    pressure."""
+
+    max_batch: int = 4
+    # logical page budget; None = full physical capacity (B * pages/slot)
+    page_budget: Optional[int] = None
+    queue_limit: int = 64
+    filter_thres: float = 0.9
+    temperature: float = 1.0
+    # occupancy fraction above which newly admitted requests are clamped
+    high_watermark: float = 0.85
+    degraded_max_new_tokens: Optional[int] = None
+    max_preemptions: int = 3
+    preempt_priority_boost: int = 1
+    prefill_attempts: int = 2
+    stall_penalty_s: float = 1.0
+
+
+class _Slot:
+    """A running request bound to one cache row."""
+
+    def __init__(self, entry: Entry, index: int, first_token: int,
+                 pos: int, admit_seq: int):
+        self.entry = entry
+        self.index = index
+        self.tok = first_token   # last sampled token (not yet cached)
+        self.pos = pos           # its internal position
+        self.admit_seq = admit_seq
+        self.cancelled = False
+
+
+@partial(jax.jit, static_argnums=(0, 5))
+def _prefill_jit(dalle: DALLE, params, cache, internal_text, key, k: int,
+                 temperature):
+    """One parallel prefill over the full text prompt + the first image
+    token sampled from its logits (same image-vocab slice + full-vocab-k
+    semantics as models/sampling.py's image_only path)."""
+    logits, mutated = dalle.apply(
+        {"params": params, "cache": cache},
+        internal_text,
+        method=DALLE.prefill_step,
+        mutable=["cache"],
+    )
+    img = logits[:, dalle.num_text_tokens_ext:]
+    tok = jax.random.categorical(
+        key, top_k_filter(img, k=k) / temperature, axis=-1
+    )
+    return mutated["cache"], tok
+
+
+@partial(jax.jit, static_argnums=(0, 6))
+def _decode_jit(dalle: DALLE, params, cache, tok, pos, keys, k: int,
+                temperature):
+    """One vector-position decode step over every slot; per-slot PRNG keys
+    (vmapped categorical) keep each row's sample stream independent of the
+    batch composition around it."""
+    logits, mutated = dalle.apply(
+        {"params": params, "cache": cache},
+        tok, pos,
+        image_only=True,
+        method=DALLE.decode_step,
+        mutable=["cache"],
+    )
+    filtered = top_k_filter(logits, k=k) / temperature
+    samples = jax.vmap(jax.random.categorical)(keys, filtered)
+    return mutated["cache"], samples.astype(jnp.int32)
+
+
+class Engine:
+    """See module docstring. Host-side state machine + one device cache."""
+
+    def __init__(self, dalle: DALLE, params, config: EngineConfig = EngineConfig(),
+                 clock: Optional[Clock] = None):
+        attn_types = tuple(dalle.attn_types or ("full",))
+        if "mlp" in attn_types:
+            raise EngineUnsupportedModel(
+                "gMLP ('mlp') layers cannot run under the serving engine: "
+                "the spatial-gate history indexes by a scalar absolute "
+                "position, so per-slot ragged offsets cannot be expressed"
+            )
+        self.dalle = dalle
+        self.params = params
+        self.config = config
+        self.clock = clock or Clock()
+
+        self.page = kv_policy.page_size()
+        self.T = dalle.text_len_internal
+        self.n_pages_slot = pages_for(self.T + dalle.image_seq_len, self.page)
+        budget = (
+            config.page_budget
+            if config.page_budget is not None
+            else config.max_batch * self.n_pages_slot
+        )
+        self.pool = PagePool(budget)
+        self.sched = Scheduler(
+            config.queue_limit,
+            preempt_priority_boost=config.preempt_priority_boost,
+        )
+
+        B = config.max_batch
+        # fixed-slot batched cache; every index leaf vectorized once
+        self.cache = set_decode_offsets(
+            init_decode_cache(dalle, params, B, cache_format="paged"),
+            jnp.zeros((B,), jnp.int32),
+        )
+        # pristine batch-1 cache, reused as every prefill's starting state
+        # (jax arrays are immutable, so sharing it is safe)
+        self._fresh1 = set_decode_offsets(
+            init_decode_cache(dalle, params, 1, cache_format="paged"),
+            jnp.zeros((1,), jnp.int32),
+        )
+        self.slots: List[Optional[_Slot]] = [None] * B
+        self.results: Dict[str, RequestResult] = {}
+        self._cancel_requested: set = set()
+        self._live: set = set()  # queued or running request ids
+        self._seq = 0
+        self._admit_seq = 0
+        self._submitted = 0
+        # top-k count derived from the FULL vocab (reference fractional-k
+        # semantics over the pre-sliced image logits; models/sampling.py)
+        self.k_img = max(int((1 - config.filter_thres) * dalle.total_tokens), 1)
+
+    # ------------------------------------------------------------ public
+
+    def submit(self, request: Request) -> Optional[RequestResult]:
+        """Queue a request; returns the RequestResult immediately on a
+        typed reject, else None (the result lands in ``self.results`` at a
+        terminal outcome)."""
+        if not (0 < request.max_new_tokens <= self.dalle.image_seq_len):
+            raise ValueError(
+                f"max_new_tokens must be in [1, {self.dalle.image_seq_len}], "
+                f"got {request.max_new_tokens}"
+            )
+        if request.request_id in self.results or request.request_id in self._live:
+            raise ValueError(f"duplicate request_id {request.request_id!r}")
+        self._submitted += 1
+        counters.inc("serve.submitted")
+        now = self.clock.now()
+        entry = Entry(request=request, submit_time=now, seq=self._seq)
+        self._seq += 1
+        if self._worst_case_pages(request.max_new_tokens) > self.pool.total:
+            return self._reject(entry, RejectReason.DEMAND_EXCEEDS_POOL)
+        if not self.sched.submit(entry):
+            return self._reject(entry, RejectReason.QUEUE_FULL)
+        self._live.add(request.request_id)
+        return None
+
+    def cancel(self, request_id: str) -> None:
+        """Request cancellation; takes effect at the next scheduling
+        iteration (queued requests terminate without ever prefilling)."""
+        self._cancel_requested.add(request_id)
+
+    def step(self) -> bool:
+        """One scheduling iteration: terminations -> admission -> one
+        decode step. Returns False when the engine is fully idle."""
+        self._sweep_terminations()
+        self._admit()
+        worked = self._decode_once()
+        self.clock.tick()
+        self._publish_gauges()
+        return worked or bool(self.sched) or any(self.slots)
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[str, RequestResult]:
+        """Drive until idle. ``max_steps`` is a test/ops safety valve: the
+        loop provably terminates (every iteration completes, terminates, or
+        advances some request, and admission cannot deadlock — an empty
+        engine has the whole pool free and over-pool demands were rejected
+        at submit), so hitting the valve is a bug, reported loudly."""
+        steps = 0
+        while self.step():
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"engine made no terminal progress in {max_steps} steps: "
+                    f"{sum(bool(s) for s in self.slots)} running, "
+                    f"{len(self.sched)} queued"
+                )
+        return self.results
+
+    def stats(self) -> dict:
+        return {
+            "submitted": self._submitted,
+            "running": sum(bool(s) for s in self.slots),
+            "queued": len(self.sched),
+            "pool_total": self.pool.total,
+            "pool_used": self.pool.used,
+            "pool_occupancy": self.pool.occupancy,
+            "outcomes": {
+                o.value: sum(
+                    1 for r in self.results.values() if r.outcome is o
+                )
+                for o in Outcome
+            },
+        }
+
+    # ------------------------------------------------------- terminations
+
+    def _sweep_terminations(self) -> None:
+        now = self.clock.now()
+        running = [s for s in self.slots if s]
+        if running and FAULTS.take("request_cancel"):
+            victim = max(running, key=lambda s: s.admit_seq)
+            counters.inc("serve.fault_request_cancel")
+            self._cancel_requested.add(victim.entry.request_id)
+        # cancellations: queued first (never prefilled -> no tokens) ...
+        for rid in list(self._cancel_requested):
+            entry = self.sched.remove(rid)
+            if entry is not None:
+                self._cancel_requested.discard(rid)
+                self._finish(entry, Outcome.CANCELLED, tokens=None)
+        # ... then running
+        for slot in list(self.slots):
+            if slot and slot.entry.request_id in self._cancel_requested:
+                self._cancel_requested.discard(slot.entry.request_id)
+                self._release_slot(slot)
+                self._finish(
+                    slot.entry, Outcome.CANCELLED,
+                    tokens=np.asarray(slot.entry.generated, np.int32),
+                )
+        # cancels naming unknown or already-finished requests (a normal
+        # client race) must not accumulate forever in a long-lived engine
+        self._cancel_requested &= self._live
+        # deadlines: queued and running alike, checked every iteration so
+        # pages come back the step the deadline passes, not at completion
+        for entry in self.sched.expired(now):
+            self._finish(entry, Outcome.DEADLINE_EXCEEDED, tokens=None)
+        for slot in list(self.slots):
+            d = slot.entry.request.deadline if slot else None
+            if slot and d is not None and now > d:
+                self._release_slot(slot)
+                self._finish(
+                    slot.entry, Outcome.DEADLINE_EXCEEDED,
+                    tokens=np.asarray(slot.entry.generated, np.int32),
+                )
+
+    # ---------------------------------------------------------- admission
+
+    def _admit(self) -> None:
+        while True:
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            if not free:
+                return
+            entry = self.sched.peek()
+            if entry is None:
+                return
+            # re-check demand against CURRENT free pages (strict
+            # head-of-line; see Scheduler docstring for the starvation
+            # rationale). Demand uses the clamped budget the request would
+            # actually get, so degradation widens the door it is sized for.
+            eff_max_new, clamped = self._degraded_budget(entry)
+            if self._worst_case_pages(eff_max_new) > self.pool.free:
+                return
+            entry = self.sched.pop()
+            entry.effective_max_new = eff_max_new
+            entry.clamped = clamped
+            if clamped:
+                counters.inc("serve.clamped")
+            prompt_pages = pages_for(self.T, self.page)
+            ok = self.pool.alloc(entry.request_id, prompt_pages)
+            assert ok, "admission checked worst-case > prompt pages"
+            try:
+                cache1, tok0 = self._prefill(entry)
+            except _PrefillFault:
+                self.pool.free_all(entry.request_id)
+                entry.prefill_attempts += 1
+                counters.inc("serve.prefill_retries")
+                if entry.prefill_attempts >= self.config.prefill_attempts:
+                    self._finish(
+                        entry, Outcome.PREFILL_FAILED, tokens=None,
+                        detail="prefill failed after "
+                               f"{entry.prefill_attempts} attempts",
+                    )
+                else:
+                    self.sched.requeue(entry)
+                continue
+            idx = free[0]
+            self.cache = insert_decode_cache(self.cache, cache1, idx)
+            now = self.clock.now()
+            entry.admit_time = now
+            entry.generated = [int(tok0)]
+            slot = _Slot(
+                entry, idx, first_token=int(tok0), pos=self.T,
+                admit_seq=self._admit_seq,
+            )
+            self._admit_seq += 1
+            self.slots[idx] = slot
+            counters.inc("serve.admitted")
+            if len(entry.generated) >= entry.effective_max_new:
+                self._complete(slot)
+
+    def _degraded_budget(self, entry: Entry) -> tuple:
+        cfg = self.config
+        want = entry.request.max_new_tokens
+        if (
+            cfg.degraded_max_new_tokens is not None
+            and self.pool.occupancy > cfg.high_watermark
+            and want > cfg.degraded_max_new_tokens
+        ):
+            return cfg.degraded_max_new_tokens, True
+        return want, False
+
+    def _worst_case_pages(self, max_new: int) -> int:
+        # positions WRITTEN to cache: the prompt (T) plus every generated
+        # token except the last (a sampled token is cached only when the
+        # next step consumes it)
+        return pages_for(self.T + max_new - 1, self.page)
+
+    def _prefill(self, entry: Entry):
+        if FAULTS.take("prefill_fail"):
+            counters.inc("serve.fault_prefill_fail")
+            raise _PrefillFault(entry.request_id)
+        text = jnp.asarray(entry.request.prompt, jnp.int32)[None, :]
+        internal = self.dalle.remap_text(text)
+        key = jax.random.fold_in(
+            jax.random.key(entry.request.seed), self.T
+        )
+        cache1, tok = _prefill_jit(
+            self.dalle, self.params, self._fresh1, internal, key,
+            self.k_img, self.config.temperature,
+        )
+        return cache1, int(tok[0])
+
+    # -------------------------------------------------------------- decode
+
+    def _decode_once(self) -> bool:
+        if FAULTS.take("decode_stall"):
+            counters.inc("serve.fault_decode_stall")
+            self.clock.advance(self.config.stall_penalty_s)
+        active = [s for s in self.slots if s]
+        if not active:
+            return False
+        # page growth: writing position ``pos`` needs pages [0, pos//page];
+        # allocate on boundary crossings, preempting on failure
+        for slot in sorted(active, key=lambda s: -self.sched.effective_priority(s.entry)):
+            if self.slots[slot.index] is not slot:
+                continue  # evicted by a previous iteration of this loop
+            needed = slot.pos // self.page + 1
+            deficit = needed - self.pool.held(slot.entry.request_id)
+            if deficit > 0 and not self._alloc_or_preempt(slot, deficit):
+                continue  # the requester itself was evicted
+        active = [s for s in self.slots if s]
+        if not active:
+            return True
+        B = self.config.max_batch
+        tok = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        keys = [jax.random.key(0)] * B
+        for s in active:
+            tok[s.index] = s.tok
+            pos[s.index] = s.pos
+            # the token at position pos+1 is drawn from this key — pure
+            # (seed, position) addressing, independent of batch history
+            keys[s.index] = jax.random.fold_in(
+                jax.random.key(s.entry.request.seed), s.pos + 1
+            )
+        self.cache, samples = _decode_jit(
+            self.dalle, self.params, self.cache,
+            jnp.asarray(tok), jnp.asarray(pos), jnp.stack(keys),
+            self.k_img, self.config.temperature,
+        )
+        samples = np.asarray(samples)
+        for s in active:
+            s.tok = int(samples[s.index])
+            s.pos += 1
+            s.entry.generated.append(s.tok)
+            if len(s.entry.generated) >= s.entry.effective_max_new:
+                self._complete(s)
+        return True
+
+    def _alloc_or_preempt(self, slot: _Slot, n: int) -> bool:
+        """Allocate ``n`` pages for ``slot``, evicting victims until it
+        fits. Returns False when the requester itself was the victim."""
+        while True:
+            blocked = FAULTS.take("page_exhaust")
+            if blocked:
+                counters.inc("serve.fault_page_exhaust")
+            if not blocked and self.pool.alloc(slot.entry.request_id, n):
+                return True
+            victim = self._pick_victim()
+            assert victim is not None, "requester is running, so a victim exists"
+            self._preempt(victim)
+            if victim is slot:
+                return False
+
+    def _pick_victim(self) -> Optional[_Slot]:
+        """Lowest effective priority dies first; within a priority the
+        YOUNGEST admission dies (it has the least sunk prefill+decode work
+        and the shortest replay)."""
+        running = [s for s in self.slots if s]
+        if not running:
+            return None
+        return min(
+            running,
+            key=lambda s: (self.sched.effective_priority(s.entry), -s.admit_seq),
+        )
+
+    def _preempt(self, slot: _Slot) -> None:
+        self._release_slot(slot)
+        entry = slot.entry
+        entry.preempt_count += 1
+        counters.inc("serve.preempted")
+        if entry.preempt_count > self.config.max_preemptions:
+            self._finish(
+                entry, Outcome.PREEMPT_CAP,
+                tokens=np.asarray(entry.generated, np.int32),
+                detail=f"evicted {entry.preempt_count} times "
+                       f"(cap {self.config.max_preemptions})",
+            )
+            return
+        # full restart: partial tokens are discarded — the (seed, position)
+        # sampling keys regenerate them bit-identically on replay
+        entry.generated = []
+        entry.admit_time = None
+        self.sched.requeue(entry)
+
+    # ----------------------------------------------------------- plumbing
+
+    def _release_slot(self, slot: _Slot) -> None:
+        """Return the slot's pages and reset its cache row to pristine:
+        page pools zeroed (``paged_kv.reset_rows`` — stale K/V must not
+        leak to the next tenant), page tables back to identity
+        (``paged_kv.reset_table_rows``), and every other per-row leaf
+        (indices, shift history) zeroed — the catch-all default, so a new
+        cache leaf is reset-safe by construction."""
+        self.pool.free_all(slot.entry.request_id)
+        idx = slot.index
+
+        def fn(path, x):
+            key = getattr(path[-1], "key", None)
+            if key in ("cached_key_pages", "cached_value_pages"):
+                return paged_kv.reset_rows(x, idx)
+            if key == "page_table":
+                return paged_kv.reset_table_rows(x, idx)
+            return x.at[idx].set(jnp.zeros_like(x[idx]))
+
+        self.cache = jax.tree_util.tree_map_with_path(fn, self.cache)
+        self.slots[slot.index] = None
+
+    def _complete(self, slot: _Slot) -> None:
+        self._release_slot(slot)
+        counters.inc("serve.completed")
+        self._finish(
+            slot.entry, Outcome.COMPLETED,
+            tokens=np.asarray(slot.entry.generated, np.int32),
+        )
+
+    def _reject(self, entry: Entry, reason: RejectReason) -> RequestResult:
+        counters.inc("serve.rejected")
+        counters.inc(f"serve.rejected.{reason.value}")
+        result = RequestResult(
+            request_id=entry.request_id,
+            outcome=Outcome.REJECTED,
+            reject_reason=reason,
+            total_latency_s=0.0,
+        )
+        self.results[entry.request_id] = result
+        return result
+
+    def _finish(self, entry: Entry, outcome: Outcome,
+                tokens: Optional[np.ndarray], detail: str = "") -> None:
+        now = self.clock.now()
+        self._live.discard(entry.request_id)
+        if outcome is not Outcome.COMPLETED:
+            counters.inc(f"serve.{outcome.value}")
+        self.results[entry.request_id] = RequestResult(
+            request_id=entry.request_id,
+            outcome=outcome,
+            tokens=tokens,
+            preempt_count=entry.preempt_count,
+            prefill_attempts=entry.prefill_attempts,
+            clamped_max_new_tokens=(
+                entry.effective_max_new if entry.clamped else None
+            ),
+            queue_latency_s=(
+                None if entry.admit_time is None
+                else entry.admit_time - entry.submit_time
+            ),
+            total_latency_s=now - entry.submit_time,
+            detail=detail,
+        )
+
+    def _publish_gauges(self) -> None:
+        gauges.set("serve.pool_occupancy", self.pool.occupancy)
+        gauges.set("serve.running", sum(bool(s) for s in self.slots))
+        gauges.set("serve.queued", len(self.sched))
+
+
+class _PrefillFault(RuntimeError):
+    """Internal: a prefill_fail injection fired (transient by contract)."""
+
+
+def check_accounting(engine: Engine) -> None:
+    """Assert the acceptance invariant: every submitted request has exactly
+    one terminal result and the pool is fully drained when idle. Tests and
+    the smoke gate call this after ``run()``."""
+    assert not any(engine.slots) and not len(engine.sched), (
+        "engine not idle"
+    )
+    assert len(engine.results) == engine._submitted, (
+        f"{engine._submitted} submitted but {len(engine.results)} results"
+    )
+    assert engine.pool.used == 0, (
+        f"page leak: {engine.pool.used} pages still held"
+    )
+    outcomes = engine.stats()["outcomes"]
+    assert sum(outcomes.values()) == engine._submitted, outcomes

@@ -1,0 +1,184 @@
+"""Admission control, page accounting, and the aged priority queue.
+
+Pure host-side bookkeeping — no jax — so every policy here is unit-testable
+without tracing anything. The engine (engine.py) owns the device arrays and
+calls into this for every "may I / who goes next / who dies" decision.
+
+Page accounting model: the paged KV cache is physically per-slot
+(``(B, n_pages, page, h*d)`` pools — every slot row can hold a full
+sequence), and ``PagePool`` is the LOGICAL budget layered over it: the
+operator caps total resident pages below ``B * n_pages_per_slot`` to model
+shared-HBM pressure (the admission/preemption control surface a physically
+shared, table-remapped pool would need — the tables exist, the remapping is
+future work; ops/paged_kv.py module docstring). Admission charges a
+request's WORST-CASE demand against free pages; allocation itself is lazy
+(prompt pages at prefill, +1 page when decode crosses a page boundary), so
+a burst of admitted-then-growing requests can still exhaust the pool —
+which is exactly the condition preempt-and-requeue exists for.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .types import Request
+
+
+def pages_for(n_positions: int, page_size: int) -> int:
+    """Pages covering ``n_positions`` written cache rows (ceil; 0 -> 0)."""
+    assert page_size > 0, page_size
+    return -(-max(0, n_positions) // page_size)
+
+
+class PagePool:
+    """Logical page budget with per-request ownership. ``alloc`` is
+    all-or-nothing; ``free`` returns everything a request holds (eviction,
+    completion, and every terminal outcome all converge on one call, so a
+    leak is structurally hard)."""
+
+    def __init__(self, total_pages: int):
+        assert total_pages > 0, total_pages
+        self.total = int(total_pages)
+        self._held: Dict[str, int] = {}
+
+    @property
+    def used(self) -> int:
+        return sum(self._held.values())
+
+    @property
+    def free(self) -> int:
+        return self.total - self.used
+
+    @property
+    def occupancy(self) -> float:
+        return self.used / self.total
+
+    def held(self, request_id: str) -> int:
+        return self._held.get(request_id, 0)
+
+    def alloc(self, request_id: str, n: int) -> bool:
+        assert n >= 0, n
+        if n > self.free:
+            return False
+        self._held[request_id] = self._held.get(request_id, 0) + n
+        return True
+
+    def free_all(self, request_id: str) -> int:
+        return self._held.pop(request_id, 0)
+
+
+@dataclass
+class Entry:
+    """A request plus its scheduling state. Lives from submit to terminal
+    outcome; rides the queue (possibly repeatedly, via preemption or
+    prefill retry) and then a slot."""
+
+    request: Request
+    submit_time: float
+    seq: int                      # submission order; FIFO tiebreak
+    preempt_count: int = 0
+    prefill_attempts: int = 0
+    # set at admission when watermark degradation clamps the budget
+    effective_max_new: int = 0
+    clamped: bool = False
+    admit_time: Optional[float] = None
+    generated: List[int] = field(default_factory=list)
+    # whether this queue residency counts against the client-facing bound
+    # (True for fresh submissions, False for preemption/retry requeues)
+    counted: bool = True
+
+    @property
+    def request_id(self) -> str:
+        return self.request.request_id
+
+
+class Scheduler:
+    """Bounded priority queue with preemption aging.
+
+    Ordering: highest effective priority first, FIFO within a priority.
+    Effective priority = the request's own priority plus
+    ``preempt_count * preempt_priority_boost`` — every eviction AGES the
+    request upward, so a low-priority request cannot be evicted forever by
+    a stream of higher-priority arrivals (the livelock guard; the hard
+    ``max_preemptions`` cap in the engine is the backstop that turns a
+    pathological loop into a typed failure instead of an invisible one).
+
+    Admission is strict head-of-line: if the best queued request does not
+    fit the free pages, nothing behind it is admitted this pass. That is a
+    deliberate anti-starvation choice — skipping ahead would let small
+    requests starve a large one indefinitely; under sustained pressure the
+    watermark clamp (engine) shrinks demand instead.
+    """
+
+    def __init__(self, queue_limit: int, preempt_priority_boost: int = 1):
+        assert queue_limit >= 0
+        self.queue_limit = queue_limit
+        self.preempt_priority_boost = preempt_priority_boost
+        self._heap: List[tuple] = []
+        self._size = 0  # entries counted against queue_limit
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def effective_priority(self, entry: Entry) -> int:
+        return (
+            entry.request.priority
+            + entry.preempt_count * self.preempt_priority_boost
+        )
+
+    def _push(self, entry: Entry) -> None:
+        heapq.heappush(
+            self._heap, (-self.effective_priority(entry), entry.seq, entry)
+        )
+
+    def submit(self, entry: Entry) -> bool:
+        """Queue a NEW submission; False when the bounded queue is full.
+        Only fresh submissions occupy the bound — requeued (preempted /
+        retrying) entries are invisible to it."""
+        if self._size >= self.queue_limit:
+            return False
+        entry.counted = True
+        self._size += 1
+        self._push(entry)
+        return True
+
+    def requeue(self, entry: Entry) -> None:
+        """Re-queue a previously ADMITTED request (preemption or prefill
+        retry). Bypasses — and does not occupy — the queue bound: the
+        request already won admission once, and letting its requeue crowd
+        out (or be bounced like) a fresh arrival would convert an internal
+        resource decision into a spurious client-visible reject."""
+        entry.counted = False
+        self._push(entry)
+
+    def peek(self) -> Optional[Entry]:
+        return self._heap[0][2] if self._heap else None
+
+    def pop(self) -> Entry:
+        entry = heapq.heappop(self._heap)[2]
+        self._size -= entry.counted
+        return entry
+
+    def remove(self, request_id: str) -> Optional[Entry]:
+        """Pull a queued entry out by id (cancellation / deadline sweep)."""
+        for i, (_, _, entry) in enumerate(self._heap):
+            if entry.request_id == request_id:
+                self._heap[i] = self._heap[-1]
+                self._heap.pop()
+                heapq.heapify(self._heap)
+                self._size -= entry.counted
+                return entry
+        return None
+
+    def expired(self, now: float) -> List[Entry]:
+        """Remove and return every queued entry whose deadline has passed
+        (they would be dead on arrival at a slot)."""
+        out = [
+            e for (_, _, e) in self._heap
+            if e.request.deadline is not None and now > e.request.deadline
+        ]
+        for e in out:
+            self.remove(e.request_id)
+        return out

@@ -1,0 +1,145 @@
+"""Serving request/response vocabulary: the typed surface of the
+continuous-batching engine.
+
+Every request submitted to the engine ends in exactly ONE
+``RequestResult`` whose ``outcome`` is a member of ``Outcome`` — there is
+no code path that drops a request silently (the acceptance invariant the
+engine tests pin: outcome counters sum to submissions). Overload and
+failure are *values* here, not exceptions: a rejected request is a result
+with a ``RejectReason``, a missed deadline is a result, a request evicted
+past the preemption cap is a result. The only exceptions the engine
+raises are programmer errors (unsupported model, bad config).
+
+The clock is injectable (``Clock`` / ``FakeClock``) so every time-driven
+behavior — deadlines, queue aging, latency accounting, the
+``decode_stall`` fault — is deterministic in CPU tests: the engine calls
+``tick()`` once per scheduling iteration, which a ``FakeClock`` turns
+into a fixed virtual step cost and the real clock ignores.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional
+
+import numpy as np
+
+
+class Outcome(str, Enum):
+    """Terminal state of a submitted request. str-valued so results
+    serialize into bench/smoke JSON without a custom encoder."""
+
+    COMPLETED = "completed"
+    REJECTED = "rejected"
+    DEADLINE_EXCEEDED = "deadline_exceeded"
+    CANCELLED = "cancelled"
+    PREEMPT_CAP = "preempt_cap"
+    PREFILL_FAILED = "prefill_failed"
+
+
+class RejectReason(str, Enum):
+    DEMAND_EXCEEDS_POOL = "demand_exceeds_pool"  # can never fit, even idle
+    QUEUE_FULL = "queue_full"                    # bounded admission queue
+
+
+@dataclass(frozen=True)
+class Request:
+    """One generation request.
+
+    ``prompt`` is the RAW text-token row ((text_seq_len,) int, 0-padded —
+    tokenizer output; the engine remaps/boses it). ``deadline`` is an
+    absolute time on the engine's clock; None = no deadline. ``priority``:
+    higher runs first and is evicted last. ``seed`` keys the request's
+    private sampling stream: token at internal position p is drawn with
+    ``fold_in(key(seed), p)``, which is what makes a preempted-and-replayed
+    request reproduce its tokens bit-identically."""
+
+    request_id: str
+    prompt: np.ndarray
+    max_new_tokens: int
+    deadline: Optional[float] = None
+    priority: int = 0
+    seed: int = 0
+
+
+@dataclass
+class RequestResult:
+    request_id: str
+    outcome: Outcome
+    # generated image-token ids; complete for COMPLETED, the partial prefix
+    # for deadline/cancel/preempt-cap terminations (callers decide whether
+    # partials are useful), None for requests that never prefilled
+    tokens: Optional[np.ndarray] = None
+    reject_reason: Optional[RejectReason] = None
+    preempt_count: int = 0
+    prefill_attempts: int = 0
+    # set when watermark degradation clamped the request's budget; the
+    # response CARRIES the clamp instead of silently under-generating
+    clamped_max_new_tokens: Optional[int] = None
+    queue_latency_s: Optional[float] = None
+    total_latency_s: Optional[float] = None
+    detail: str = ""
+
+    def to_json(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "outcome": self.outcome.value,
+            "n_tokens": None if self.tokens is None else int(len(self.tokens)),
+            "reject_reason": (
+                None if self.reject_reason is None else self.reject_reason.value
+            ),
+            "preempt_count": self.preempt_count,
+            "prefill_attempts": self.prefill_attempts,
+            "clamped_max_new_tokens": self.clamped_max_new_tokens,
+            "queue_latency_s": self.queue_latency_s,
+            "total_latency_s": self.total_latency_s,
+            "detail": self.detail,
+        }
+
+
+# ------------------------------------------------------------------ clock
+
+
+class Clock:
+    """Engine time source. ``now()`` is an absolute monotonic time;
+    ``tick()`` is called once per engine scheduling iteration (a seam, not
+    a timer); ``advance(dt)`` jumps time forward — the ``decode_stall``
+    fault drives it."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def tick(self) -> None:
+        pass
+
+    def advance(self, dt: float) -> None:
+        # real time cannot be jumped; a stall on the real clock is a sleep
+        time.sleep(dt)
+
+
+@dataclass
+class FakeClock(Clock):
+    """Deterministic virtual clock: every engine iteration costs a fixed
+    ``step_dt`` (so "a deadline mid-decode" is an exact step count in
+    tests) and ``advance`` jumps instantly."""
+
+    t: float = 0.0
+    step_dt: float = 0.0
+
+    def now(self) -> float:
+        return self.t
+
+    def tick(self) -> None:
+        self.t += self.step_dt
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class EngineUnsupportedModel(ValueError):
+    """The model cannot run under the continuous-batching engine (gMLP
+    layers: the spatial-gate history indexes by a scalar absolute position,
+    so per-slot ragged offsets cannot be expressed — same restriction as
+    ``merge_decode_caches``/``set_decode_offsets``)."""

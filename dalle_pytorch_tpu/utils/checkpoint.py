@@ -34,9 +34,22 @@ import numpy as np
 from flax import serialization
 
 from .faults import FAULTS
-from .resilience import COMMIT_NAME, verify_dir_manifest, write_dir_manifest
+from .resilience import (
+    COMMIT_NAME,
+    FILE_MANIFEST_SUFFIX,
+    verify_dir_manifest,
+    verify_file_manifest,
+    write_dir_manifest,
+    write_file_manifest,
+)
 
 _HEADER_KEY = "__dalle_tpu_meta__"
+
+
+class CheckpointError(RuntimeError):
+    """Typed load failure: missing, torn, or corrupt checkpoint. CLIs catch
+    this and exit nonzero with the reason instead of surfacing a msgpack
+    stack trace (or, pre-manifest, silently deserializing garbage)."""
 
 
 def _to_host(tree: Any) -> Any:
@@ -55,7 +68,15 @@ def save_checkpoint(path: str, state: Any, meta: Optional[dict] = None) -> None:
     p.parent.mkdir(parents=True, exist_ok=True)
     tmp = p.with_suffix(p.suffix + ".tmp")
     tmp.write_bytes(serialization.msgpack_serialize(payload))
+    # invalidate any PREVIOUS save's sidecar before the content swap: a
+    # crash between replace and the new sidecar must leave "no manifest"
+    # (unverified but loadable), never a stale manifest describing the old
+    # bytes that would condemn a perfectly good new file as corrupt
+    Path(str(p) + FILE_MANIFEST_SUFFIX).unlink(missing_ok=True)
     tmp.replace(p)  # atomic: never leave a torn checkpoint
+    # sha256+size sidecar, written last (single-file two-phase commit):
+    # serving loads verify against it instead of trusting the file
+    write_file_manifest(p)
 
 
 def load_checkpoint(path: str, target: Any = None) -> tuple[Any, dict]:
@@ -67,6 +88,28 @@ def load_checkpoint(path: str, target: Any = None) -> tuple[Any, dict]:
     if target is not None:
         state = serialization.from_state_dict(target, state)
     return state, meta
+
+
+def check_checkpoint_file(path: str, require_manifest: bool = False) -> None:
+    """Refuse a missing/torn/corrupt plain checkpoint BEFORE deserializing
+    it — raises ``CheckpointError`` with the manifest verifier's reason.
+
+    Serving entry points (generate.py) call this instead of
+    ``assert Path(...).exists()``: an existence check happily loads a file
+    truncated by a crashed save or bit-rotted in transit. A checkpoint
+    without a sidecar (saved pre-manifest) passes with a stderr warning
+    unless ``require_manifest``; msgpack parse errors downstream still
+    surface, they are just no longer the FIRST line of defense."""
+    ok, reason = verify_file_manifest(path)
+    if ok:
+        return
+    if reason == "no manifest" and not require_manifest:
+        print(
+            f"WARNING: {path} has no manifest sidecar (pre-manifest save); "
+            "loading unverified", file=sys.stderr,
+        )
+        return
+    raise CheckpointError(f"checkpoint {path}: {reason}")
 
 
 # ----------------------------------------------------------- sharded format

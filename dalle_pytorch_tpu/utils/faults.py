@@ -23,6 +23,17 @@ Sites in use:
                  step dir is corrupted after the manifest is written
 ``nan_at_step``  ``parallel.step`` via the trainer: the loss is forced to NaN
                  at global step K (value-style site: the armed count IS K)
+``page_exhaust`` ``serving.engine``: a decode-time page allocation fails N
+                 times even though the pool has free pages — forces the
+                 preempt-and-requeue path without needing real pressure
+``prefill_fail`` ``serving.engine``: the prefill pass raises a transient
+                 ``RuntimeError`` N times (the request is requeued and
+                 retried up to the engine's attempt budget)
+``decode_stall`` ``serving.engine``: one decode iteration stalls — the
+                 engine clock jumps by ``stall_penalty_s``, pushing
+                 in-flight requests toward their deadlines
+``request_cancel`` ``serving.engine``: the youngest running request is
+                 cancelled mid-decode (models a client disconnect)
 ===============  =============================================================
 
 Injection must be impossible to leave on by accident: the registry is
@@ -42,6 +53,14 @@ ENV_VAR = "DALLE_TPU_FAULTS"
 # of failures to consume
 _VALUE_SITES = frozenset({"nan_at_step"})
 
+# every site referenced by production code; the env-spec parser rejects
+# anything else so a typo'd site name fails the run instead of silently
+# injecting nothing (programmatic ``arm`` stays open for test-local sites)
+KNOWN_SITES = frozenset({
+    "download", "shard_open", "shard_read", "ckpt_corrupt", "nan_at_step",
+    "page_exhaust", "prefill_fail", "decode_stall", "request_cancel",
+})
+
 
 def _parse_spec(spec: str) -> Dict[str, int]:
     out: Dict[str, int] = {}
@@ -54,7 +73,13 @@ def _parse_spec(spec: str) -> Dict[str, int]:
                 f"bad {ENV_VAR} entry {part!r}: want site=count"
             )
         site, _, count = part.partition("=")
-        out[site.strip()] = int(count)
+        site = site.strip()
+        if site not in KNOWN_SITES:
+            raise ValueError(
+                f"unknown fault site {site!r} in {ENV_VAR} "
+                f"(known: {sorted(KNOWN_SITES)})"
+            )
+        out[site] = int(count)
     return out
 
 

@@ -53,6 +53,40 @@ class Counters:
 counters = Counters()
 
 
+class Gauges:
+    """Process-wide named gauges (last value wins) — the level companion to
+    ``Counters``. The serving engine publishes pool occupancy and queue/
+    running depths here each scheduling pass so an operator dashboard (or a
+    test) reads the engine's current pressure without reaching into it.
+    Thread-safe for the same reason Counters is."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: Dict[str, float] = {}
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._values[name] = float(value)
+
+    def get(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._values.get(name, default)
+
+    def snapshot(self, prefix: str = "") -> Dict[str, float]:
+        with self._lock:
+            return {
+                k: v for k, v in sorted(self._values.items())
+                if k.startswith(prefix)
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+
+gauges = Gauges()
+
+
 class MetricsLogger:
     def __init__(
         self,

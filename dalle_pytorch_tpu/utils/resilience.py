@@ -174,6 +174,49 @@ def write_dir_manifest(dirpath: str, extra: Optional[dict] = None) -> None:
     ctmp.replace(root / COMMIT_NAME)
 
 
+FILE_MANIFEST_SUFFIX = ".manifest.json"
+
+
+def write_file_manifest(path: str) -> None:
+    """Sidecar manifest for a SINGLE-file artifact (the plain msgpack
+    checkpoint format): ``<path>.manifest.json`` holding sha256 + byte
+    size, written atomically AFTER the artifact itself — the single-file
+    analog of the directory two-phase commit. A crash between the artifact
+    replace and the sidecar write leaves an unverified (not poisoned)
+    file; readers distinguish "no manifest" from "manifest mismatch"."""
+    p = Path(path)
+    manifest = {"sha256": _sha256(p), "bytes": p.stat().st_size}
+    mpath = Path(str(p) + FILE_MANIFEST_SUFFIX)
+    tmp = mpath.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(manifest, indent=1, sort_keys=True))
+    tmp.replace(mpath)
+
+
+def verify_file_manifest(path: str) -> Tuple[bool, str]:
+    """-> (ok, reason). ``reason`` is ``"no manifest"`` when the sidecar is
+    absent (a pre-manifest artifact — callers decide whether unverified is
+    acceptable), otherwise names the failure: size drift (torn write) or
+    checksum mismatch (bit corruption)."""
+    p = Path(path)
+    if not p.exists():
+        return False, "file missing"
+    mpath = Path(str(p) + FILE_MANIFEST_SUFFIX)
+    if not mpath.exists():
+        return False, "no manifest"
+    try:
+        manifest = json.loads(mpath.read_text())
+        want_sha, want_bytes = manifest["sha256"], manifest["bytes"]
+    except (ValueError, KeyError) as e:
+        return False, f"unreadable manifest: {e}"
+    if p.stat().st_size != want_bytes:
+        return False, (
+            f"size mismatch: {p.stat().st_size} != {want_bytes} (torn write)"
+        )
+    if _sha256(p) != want_sha:
+        return False, "checksum mismatch (bit corruption)"
+    return True, "ok"
+
+
 def verify_dir_manifest(dirpath: str) -> Tuple[bool, str]:
     """-> (ok, reason). Unverified means: no commit marker (torn save),
     no/unreadable manifest, a listed file missing, size drift, or a

@@ -4,12 +4,22 @@
 Mirrors the reference ``generate.py`` surface: checkpoint carries all hparams
 (no model flags needed), prompts split on '|', batched generation, numbered
 outputs per prompt under --outputs_dir, optional text completion (--gentxt).
-Sampling runs the KV-cached scan decoder (one compile, O(seq) per token)
-instead of the reference's full re-forward per token
-(dalle_pytorch.py:481-486).
+
+Image generation runs through the continuous-batching serving ENGINE
+(dalle_pytorch_tpu/serving): each image is a ``Request`` with its own seed,
+decoded over the paged KV cache with admission control and typed outcomes —
+the CLI exercises the same code path production serving does, instead of a
+parallel one-shot path that only looks similar. Models the engine cannot
+serve (gMLP layers) fall back to the fused scan decoder
+(models/sampling.py) with a printed note.
+
+The checkpoint is refused unless it verifies against its manifest sidecar
+(sha256+size, utils/checkpoint.py) — a torn or bit-rotted file exits with a
+typed error instead of deserializing garbage.
 """
 
 import argparse
+import sys
 from pathlib import Path
 
 
@@ -53,6 +63,36 @@ def parse_args():
     return parser.parse_args()
 
 
+def _engine_image_tokens(engine, dalle, prompt_row, num_images, tag, seed):
+    """Generate ``num_images`` image-token sequences for one prompt through
+    the (shared, reused across prompts) serving engine: one Request per
+    image, each with its own (seed, position)-addressed sampling stream and
+    a per-prompt ``tag`` namespacing its id. Every request must COMPLETE
+    here (no deadlines, default pool) — any other outcome is a bug surfaced
+    as a RuntimeError, never a silently missing image."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.serving import Outcome, Request
+
+    ids = [f"{tag}-img{i}" for i in range(num_images)]
+    for i, rid in enumerate(ids):
+        rejected = engine.submit(Request(
+            request_id=rid,
+            prompt=np.asarray(prompt_row, np.int32),
+            max_new_tokens=dalle.image_seq_len,
+            seed=seed + i,
+        ))
+        assert rejected is None, rejected
+    results = engine.run()
+    bad = {
+        rid: results[rid].outcome.value for rid in ids
+        if results[rid].outcome is not Outcome.COMPLETED
+    }
+    if bad:
+        raise RuntimeError(f"engine failed requests: {bad}")
+    return np.stack([results[rid].tokens for rid in ids])
+
+
 def main():
     args = parse_args()
 
@@ -65,8 +105,20 @@ def main():
     from dalle_pytorch_tpu.models import generate_image_tokens, generate_texts
     from dalle_pytorch_tpu.models.factory import dalle_from_checkpoint
     from dalle_pytorch_tpu.models.vae import denormalize
+    from dalle_pytorch_tpu.serving import EngineUnsupportedModel
+    from dalle_pytorch_tpu.utils.checkpoint import (
+        CheckpointError, check_checkpoint_file,
+    )
 
-    assert Path(args.dalle_path).exists(), f"checkpoint not found at {args.dalle_path}"
+    try:
+        check_checkpoint_file(args.dalle_path)
+    except CheckpointError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        print(
+            "refusing to load an unverifiable checkpoint; regenerate it or "
+            "restore from a verified save", file=sys.stderr,
+        )
+        sys.exit(2)
     dalle, params, vae, vae_params, meta = dalle_from_checkpoint(
         args.dalle_path,
         vae_weight_paths={
@@ -105,7 +157,29 @@ def main():
         lambda seq: vae.apply({"params": vae_params}, seq, method="decode")
     )
 
-    for text in texts:
+    # ONE engine reused across prompts (the decode caches are allocated at
+    # construction); gMLP models get the fused-scan fallback instead
+    engine = None
+    try:
+        from dalle_pytorch_tpu.serving import Engine, EngineConfig
+
+        engine = Engine(
+            dalle, params,
+            EngineConfig(
+                max_batch=args.batch_size,
+                queue_limit=max(args.num_images, 1),
+                filter_thres=args.top_k,
+                temperature=args.temperature,
+            ),
+        )
+    except EngineUnsupportedModel as e:
+        print(
+            f"serving engine unavailable for this model ({e}); "
+            "falling back to the fused scan decoder",
+            file=sys.stderr,
+        )
+
+    for pi, text in enumerate(texts):
         if args.gentxt:
             prompt_ids = jnp.asarray([tokenizer.encode(text)], jnp.int32)
             key, sub = jax.random.split(key)
@@ -116,20 +190,38 @@ def main():
             text = completed[0].strip() if completed else text
             print(f"completed prompt: {text}")
 
-        tokens = tokenizer.tokenize(
-            [text], dalle.text_seq_len, truncate_text=True
-        ).repeat(args.batch_size, axis=0)
-        tokens = jnp.asarray(tokens)
+        prompt_row = np.asarray(
+            tokenizer.tokenize([text], dalle.text_seq_len, truncate_text=True)
+        )[0]
+
+        if engine is not None:
+            seqs = _engine_image_tokens(
+                engine, dalle, prompt_row, args.num_images, tag=f"p{pi}",
+                seed=args.seed * 1_000_003 + pi * 65_537,
+            )
+        else:
+            tokens = jnp.asarray(
+                np.repeat(prompt_row[None], args.batch_size, axis=0)
+            )
+            chunks = []
+            for _ in range(-(-args.num_images // args.batch_size)):
+                key, sub = jax.random.split(key)
+                chunks.append(np.asarray(generate_image_tokens(
+                    dalle, params, tokens, sub,
+                    filter_thres=args.top_k, temperature=args.temperature,
+                )))
+            seqs = np.concatenate(chunks)[: args.num_images]
 
         images = []
-        for _ in range(-(-args.num_images // args.batch_size)):
-            key, sub = jax.random.split(key)
-            img_seq = generate_image_tokens(
-                dalle, params, tokens, sub,
-                filter_thres=args.top_k, temperature=args.temperature,
-            )
-            images.append(np.asarray(decode(img_seq)))
-        images = np.concatenate(images)[: args.num_images]
+        for s in range(0, len(seqs), args.batch_size):
+            chunk = seqs[s : s + args.batch_size]
+            n = len(chunk)
+            if n < args.batch_size:  # pad the ragged tail for the jit shape
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], args.batch_size - n, axis=0)]
+                )
+            images.append(np.asarray(decode(jnp.asarray(chunk)))[:n])
+        images = np.concatenate(images)
 
         images = denormalize(images, getattr(vae, "normalization", None))
 

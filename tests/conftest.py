@@ -60,21 +60,34 @@ assert not os.environ.get("DALLE_TPU_FAULTS"), (
     "suite requires fault injection off (tests arm FAULTS programmatically)"
 )
 
+# ... and the registry itself must start inert, with every production site
+# (including the serving sites PR 3 added) known to it — a site name typo'd
+# out of KNOWN_SITES would arm nothing and silently test nothing
+from dalle_pytorch_tpu.utils.faults import FAULTS as _FAULTS  # noqa: E402
+from dalle_pytorch_tpu.utils.faults import KNOWN_SITES as _SITES  # noqa: E402
+
+assert not _FAULTS.active(), "fault registry armed at session start"
+for _site in ("page_exhaust", "prefill_fail", "decode_stall",
+              "request_cancel", "download", "ckpt_corrupt"):
+    assert _site in _SITES, f"production fault site {_site!r} unregistered"
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
 def _reset_resilience_registries():
-    """Keep the process-wide fault registry and fault counters hermetic:
-    a test that arms faults or trips counters must not leak into the next."""
+    """Keep the process-wide fault registry, counters, and gauges hermetic:
+    a test that arms faults or trips metrics must not leak into the next."""
     from dalle_pytorch_tpu.utils.faults import FAULTS
-    from dalle_pytorch_tpu.utils.metrics import counters
+    from dalle_pytorch_tpu.utils.metrics import counters, gauges
 
     FAULTS.reset()
     counters.reset()
+    gauges.reset()
     yield
     FAULTS.reset()
     counters.reset()
+    gauges.reset()
 
 
 def pytest_collection_modifyitems(config, items):

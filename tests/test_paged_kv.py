@@ -101,6 +101,20 @@ class TestPageOps:
         assert flat[0, 3].sum() == f  # in-capacity row landed
         assert flat[0, :3].sum() == 0  # the overflow row vanished, no wrap
 
+    def test_reset_rows_and_tables(self):
+        """Eviction reset (serving engine): the victim's rows go back to
+        pristine — zero pages, identity table — with other rows untouched."""
+        rng = np.random.RandomState(2)
+        pool = jnp.asarray(rng.rand(3, 2, 4, 2), jnp.float32)
+        table = jnp.asarray([[1, 0], [0, 1], [1, 0]], jnp.int32)
+        pool2 = paged_kv.reset_rows(pool, 1)
+        assert np.asarray(pool2)[1].sum() == 0
+        np.testing.assert_array_equal(np.asarray(pool2)[[0, 2]], np.asarray(pool)[[0, 2]])
+        table2 = paged_kv.reset_table_rows(table, [0, 2])
+        np.testing.assert_array_equal(
+            np.asarray(table2), [[0, 1], [0, 1], [0, 1]]
+        )
+
     def test_gather_variants_match(self):
         rng = np.random.RandomState(1)
         pool = jnp.asarray(rng.rand(2, 3, 4, 8), jnp.float32)
@@ -356,6 +370,32 @@ class TestPolicy:
         monkeypatch.setenv("DALLE_TPU_FLAT_KV", "maybe")
         with pytest.raises(ValueError):
             kv_policy.choose_cache_format(8)
+
+    def test_invalid_override_is_named_error_listing_formats(self, monkeypatch):
+        """An unknown format must fail AT POLICY RESOLUTION with the named
+        error, naming every valid format — not as a shape error deep inside
+        cache init. Covers all three override channels."""
+        monkeypatch.setenv("DALLE_TPU_KV_FORMAT", "paged2")
+        with pytest.raises(kv_policy.InvalidKVFormatError) as ei:
+            kv_policy.choose_cache_format(4)
+        for fmt in kv_policy.FORMATS:
+            assert fmt in str(ei.value)
+        assert "DALLE_TPU_KV_FORMAT" in str(ei.value)
+        monkeypatch.delenv("DALLE_TPU_KV_FORMAT")
+
+        with pytest.raises(kv_policy.InvalidKVFormatError, match="cache_format"):
+            kv_policy.resolve_format("bogus", 4)
+        with pytest.raises(kv_policy.InvalidKVFormatError):
+            with kv_policy.format_override("bogus"):
+                pass
+        # ... and through the model entry point (init at trace time)
+        dalle = small_dalle()
+        text, image = dalle_inputs(dalle)
+        params = dalle.init(jax.random.key(0), text, image)["params"]
+        with pytest.raises(kv_policy.InvalidKVFormatError):
+            init_decode_cache(dalle, params, 2, cache_format="bogus")
+        # the named error stays a ValueError for pre-existing callers
+        assert issubclass(kv_policy.InvalidKVFormatError, ValueError)
 
     def test_choices_are_recorded(self):
         n0 = len(kv_policy.CHOICE_LOG)

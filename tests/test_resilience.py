@@ -86,6 +86,88 @@ class TestFaultRegistry:
         with pytest.raises(ValueError):
             FaultRegistry("download")
 
+    def test_unknown_site_in_spec_rejected(self):
+        """A typo'd site name in DALLE_TPU_FAULTS must fail the run, not
+        silently inject nothing (the drill would 'pass' untested)."""
+        with pytest.raises(ValueError, match="unknown fault site"):
+            FaultRegistry("page_exhaustt=1")
+
+    def test_serving_sites_are_known(self):
+        from dalle_pytorch_tpu.utils.faults import KNOWN_SITES
+
+        r = FaultRegistry(
+            "page_exhaust=1,prefill_fail=1,decode_stall=1,request_cancel=1"
+        )
+        for site in ("page_exhaust", "prefill_fail", "decode_stall",
+                     "request_cancel"):
+            assert site in KNOWN_SITES
+            assert r.take(site) and not r.take(site)
+
+
+class TestFileManifest:
+    """Single-file sidecar manifests — what generate.py's checkpoint gate
+    stands on (the single-file analog of the step-dir two-phase commit)."""
+
+    def test_save_checkpoint_writes_sidecar_and_verifies(self, tmp_path):
+        from dalle_pytorch_tpu.utils.checkpoint import (
+            check_checkpoint_file, save_checkpoint,
+        )
+        from dalle_pytorch_tpu.utils.resilience import verify_file_manifest
+
+        path = tmp_path / "m.ckpt"
+        save_checkpoint(str(path), {"w": np.ones(3)}, {"k": 1})
+        assert (tmp_path / "m.ckpt.manifest.json").exists()
+        ok, reason = verify_file_manifest(str(path))
+        assert ok, reason
+        check_checkpoint_file(str(path))  # no raise
+
+    def test_corruption_is_typed_error(self, tmp_path):
+        from dalle_pytorch_tpu.utils.checkpoint import (
+            CheckpointError, check_checkpoint_file, save_checkpoint,
+        )
+
+        path = tmp_path / "m.ckpt"
+        save_checkpoint(str(path), {"w": np.ones(3)}, {})
+        data = bytearray(path.read_bytes())
+        data[len(data) // 2] ^= 0xFF
+        path.write_bytes(bytes(data))
+        with pytest.raises(CheckpointError, match="checksum mismatch"):
+            check_checkpoint_file(str(path))
+
+    def test_truncation_is_typed_error(self, tmp_path):
+        from dalle_pytorch_tpu.utils.checkpoint import (
+            CheckpointError, check_checkpoint_file, save_checkpoint,
+        )
+
+        path = tmp_path / "m.ckpt"
+        save_checkpoint(str(path), {"w": np.ones(64)}, {})
+        path.write_bytes(path.read_bytes()[:-16])  # torn write
+        with pytest.raises(CheckpointError, match="size mismatch"):
+            check_checkpoint_file(str(path))
+
+    def test_missing_file_is_typed_error(self, tmp_path):
+        from dalle_pytorch_tpu.utils.checkpoint import (
+            CheckpointError, check_checkpoint_file,
+        )
+
+        with pytest.raises(CheckpointError, match="missing"):
+            check_checkpoint_file(str(tmp_path / "nope.ckpt"))
+
+    def test_pre_manifest_file_warns_but_loads(self, tmp_path, capsys):
+        """Checkpoints saved before the sidecar existed stay loadable
+        (warn, don't refuse) unless the caller requires verification."""
+        from dalle_pytorch_tpu.utils.checkpoint import (
+            CheckpointError, check_checkpoint_file, save_checkpoint,
+        )
+
+        path = tmp_path / "m.ckpt"
+        save_checkpoint(str(path), {"w": np.ones(3)}, {})
+        (tmp_path / "m.ckpt.manifest.json").unlink()
+        check_checkpoint_file(str(path))  # warns, no raise
+        assert "no manifest sidecar" in capsys.readouterr().err
+        with pytest.raises(CheckpointError, match="no manifest"):
+            check_checkpoint_file(str(path), require_manifest=True)
+
 
 # -------------------------------------------------------------------- retry
 
