@@ -27,6 +27,7 @@ from PIL import Image
 from ..utils.faults import FAULTS
 from ..utils.metrics import counters
 from ..utils.resilience import RetryPolicy, retry
+from ..utils.telemetry import TELEMETRY
 from .loader import image_to_array, random_resized_crop
 
 IMAGE_KEYS = ("jpg", "jpeg", "png", "img", "image")
@@ -207,6 +208,12 @@ class TarImageTextDataset:
         except self.retry_policy.retry_on as e:
             self._quarantined.add(url)
             counters.inc("webdata.shards_quarantined")
+            # flight-recorder events carry the counter name they increment
+            # so a postmortem trace joins against the metric series
+            TELEMETRY.event(
+                "data.shard_quarantined", url=url,
+                counter="webdata.shards_quarantined", error=str(e),
+            )
             print(
                 f"shard {url} quarantined after "
                 f"{self.retry_policy.attempts} attempts: {e}",
@@ -214,6 +221,9 @@ class TarImageTextDataset:
             )
             return None
         counters.inc("webdata.shards_opened")
+        TELEMETRY.event(
+            "data.shard_open", url=url, counter="webdata.shards_opened",
+        )
         return stream
 
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
@@ -248,6 +258,10 @@ class TarImageTextDataset:
                 # mid-shard corruption/truncation: keep what streamed,
                 # move on to the next shard — counted, not silent
                 counters.inc("webdata.shard_aborts")
+                TELEMETRY.event(
+                    "data.shard_abort", url=url,
+                    counter="webdata.shard_aborts", error=str(e),
+                )
                 print(f"shard {url} aborted: {e}", file=sys.stderr)
             finally:
                 stream.close()

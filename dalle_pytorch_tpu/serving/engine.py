@@ -25,6 +25,14 @@ discarded, never read cross-row). Re-running an evicted request therefore
 reproduces its tokens bit-identically — preemption costs work, never
 changes output.
 
+Observability (docs/DESIGN.md §9): every request is one
+``serve.request`` telemetry span — begun at submit, ended with its typed
+outcome — with ``serve.prefill``/``serve.slot_insert`` child spans, admit/
+evict/stall events, and one ``serve.decode_step`` span per engine
+iteration; queue-wait and request-latency land in ``serve.*`` histograms.
+All of it is host-side (``utils/telemetry.py`` never touches jax) and
+free when telemetry is disabled.
+
 Throughput note: this loop dispatches one jitted step per generated token
 (a host decision point between steps is the price of admission control,
 deadlines, and preemption). Single-shot batch generation without a request
@@ -51,7 +59,8 @@ from ..models.sampling import (
 )
 from ..ops import kv_policy, paged_kv
 from ..utils.faults import FAULTS
-from ..utils.metrics import counters, gauges
+from ..utils.metrics import counters, gauges, histograms
+from ..utils.telemetry import TELEMETRY
 from .scheduler import Entry, PagePool, Scheduler, pages_for
 from .types import (
     Clock,
@@ -180,6 +189,11 @@ class Engine:
         )
         self.slots: List[Optional[_Slot]] = [None] * B
         self.results: Dict[str, RequestResult] = {}
+        # open telemetry lifecycle spans: one "serve.request" per live
+        # request, ended with its typed outcome (docs/DESIGN.md §9). The
+        # dict stays empty when telemetry is disabled (begin returns None
+        # and end(None) is a no-op), so the engine pays ~nothing.
+        self._req_spans: Dict[str, Optional[int]] = {}
         self._cancel_requested: set = set()
         self._live: set = set()  # queued or running request ids
         self._seq = 0
@@ -207,6 +221,12 @@ class Engine:
         now = self.clock.now()
         entry = Entry(request=request, submit_time=now, seq=self._seq)
         self._seq += 1
+        self._req_spans[request.request_id] = TELEMETRY.begin(
+            "serve.request",
+            request_id=request.request_id,
+            priority=request.priority,
+            max_new_tokens=request.max_new_tokens,
+        )
         if self._worst_case_pages(request.max_new_tokens) > self.pool.total:
             return self._reject(entry, RejectReason.DEMAND_EXCEEDS_POOL)
         if not self.sched.submit(entry):
@@ -327,12 +347,22 @@ class Engine:
             prompt_pages = pages_for(self.T, self.page)
             ok = self.pool.alloc(entry.request_id, prompt_pages)
             assert ok, "admission checked worst-case > prompt pages"
+            req_span = self._req_spans.get(entry.request_id)
             try:
-                cache1, tok0 = self._prefill(entry)
+                with TELEMETRY.span(
+                    "serve.prefill",
+                    request_id=entry.request_id, parent=req_span,
+                    attempt=entry.prefill_attempts,
+                ):
+                    cache1, tok0 = self._prefill(entry)
             except _PrefillFault:
                 self.pool.free_all(entry.request_id)
                 entry.prefill_attempts += 1
                 counters.inc("serve.prefill_retries")
+                TELEMETRY.event(
+                    "serve.prefill_retry", request_id=entry.request_id,
+                    parent=req_span, attempt=entry.prefill_attempts,
+                )
                 if entry.prefill_attempts >= self.config.prefill_attempts:
                     self._finish(
                         entry, Outcome.PREFILL_FAILED, tokens=None,
@@ -343,10 +373,22 @@ class Engine:
                     self.sched.requeue(entry)
                 continue
             idx = free[0]
-            self.cache = insert_decode_cache(self.cache, cache1, idx)
+            with TELEMETRY.span(
+                "serve.slot_insert",
+                request_id=entry.request_id, parent=req_span, slot=idx,
+            ):
+                self.cache = insert_decode_cache(self.cache, cache1, idx)
             now = self.clock.now()
             entry.admit_time = now
             entry.generated = [int(tok0)]
+            # queue wait = submit (or preemption requeue's ORIGINAL
+            # submit) to this admission — what the client experienced
+            histograms.observe("serve.queue_wait_s", now - entry.submit_time)
+            TELEMETRY.event(
+                "serve.admit", request_id=entry.request_id, parent=req_span,
+                slot=idx, queue_wait_s=now - entry.submit_time,
+                clamped=clamped,
+            )
             slot = _Slot(
                 entry, idx, first_token=int(tok0), pos=self.T,
                 admit_seq=self._admit_seq,
@@ -394,6 +436,9 @@ class Engine:
     def _decode_once(self) -> bool:
         if FAULTS.take("decode_stall"):
             counters.inc("serve.fault_decode_stall")
+            TELEMETRY.event(
+                "serve.decode_stall", penalty_s=self.config.stall_penalty_s
+            )
             self.clock.advance(self.config.stall_penalty_s)
         active = [s for s in self.slots if s]
         if not active:
@@ -411,23 +456,27 @@ class Engine:
         if not active:
             return True
         B = self.config.max_batch
-        tok = np.zeros((B,), np.int32)
-        pos = np.zeros((B,), np.int32)
-        keys = [jax.random.key(0)] * B
-        for s in active:
-            tok[s.index] = s.tok
-            pos[s.index] = s.pos
-            # the token at position pos+1 is drawn from this key — pure
-            # (seed, position) addressing, independent of batch history
-            keys[s.index] = jax.random.fold_in(
-                jax.random.key(s.entry.request.seed), s.pos + 1
+        # ONE span per engine iteration (one generated token per active
+        # slot), opened/closed host-side around the already-synchronizing
+        # np.asarray — the span itself adds no device syncs
+        with TELEMETRY.span("serve.decode_step", n_active=len(active)):
+            tok = np.zeros((B,), np.int32)
+            pos = np.zeros((B,), np.int32)
+            keys = [jax.random.key(0)] * B
+            for s in active:
+                tok[s.index] = s.tok
+                pos[s.index] = s.pos
+                # the token at position pos+1 is drawn from this key — pure
+                # (seed, position) addressing, independent of batch history
+                keys[s.index] = jax.random.fold_in(
+                    jax.random.key(s.entry.request.seed), s.pos + 1
+                )
+            self.cache, samples = _decode_jit(
+                self.dalle, self.params, self.cache,
+                jnp.asarray(tok), jnp.asarray(pos), jnp.stack(keys),
+                self.k_img, self.config.temperature,
             )
-        self.cache, samples = _decode_jit(
-            self.dalle, self.params, self.cache,
-            jnp.asarray(tok), jnp.asarray(pos), jnp.stack(keys),
-            self.k_img, self.config.temperature,
-        )
-        samples = np.asarray(samples)
+            samples = np.asarray(samples)
         for s in active:
             s.tok = int(samples[s.index])
             s.pos += 1
@@ -468,6 +517,12 @@ class Engine:
         entry = slot.entry
         entry.preempt_count += 1
         counters.inc("serve.preempted")
+        TELEMETRY.event(
+            "serve.evict", request_id=entry.request_id,
+            parent=self._req_spans.get(entry.request_id),
+            preempt_count=entry.preempt_count,
+            tokens_discarded=len(entry.generated),
+        )
         if entry.preempt_count > self.config.max_preemptions:
             self._finish(
                 entry, Outcome.PREEMPT_CAP,
@@ -516,6 +571,11 @@ class Engine:
     def _reject(self, entry: Entry, reason: RejectReason) -> RequestResult:
         counters.inc("serve.rejected")
         counters.inc(f"serve.rejected.{reason.value}")
+        TELEMETRY.end(
+            self._req_spans.pop(entry.request_id, None),
+            outcome=Outcome.REJECTED.value, reject_reason=reason.value,
+        )
+        histograms.observe("serve.request_latency_s", 0.0)
         result = RequestResult(
             request_id=entry.request_id,
             outcome=Outcome.REJECTED,
@@ -531,6 +591,20 @@ class Engine:
         self._live.discard(entry.request_id)
         if outcome is not Outcome.COMPLETED:
             counters.inc(f"serve.{outcome.value}")
+        # the lifecycle span ends HERE, in its typed outcome — the flight
+        # recorder's per-request chain is submit(B) .. outcome(E)
+        TELEMETRY.end(
+            self._req_spans.pop(entry.request_id, None),
+            outcome=outcome.value,
+            n_tokens=0 if tokens is None else int(len(tokens)),
+            preempt_count=entry.preempt_count,
+            detail=detail,
+        )
+        histograms.observe("serve.request_latency_s", now - entry.submit_time)
+        if outcome is Outcome.COMPLETED:
+            histograms.observe(
+                "serve.completed_latency_s", now - entry.submit_time
+            )
         self.results[entry.request_id] = RequestResult(
             request_id=entry.request_id,
             outcome=outcome,

@@ -8,7 +8,18 @@ from .checkpoint import (
 )
 from .download import CACHE_DIR, download
 from .faults import FAULTS, FaultRegistry
-from .metrics import Counters, MetricsLogger, Throughput, counters, mfu
+from .metrics import (
+    Counters,
+    Gauges,
+    Histogram,
+    Histograms,
+    MetricsLogger,
+    Throughput,
+    counters,
+    gauges,
+    histograms,
+    mfu,
+)
 from .quantize import (
     prepare_for_serving,
     quantize_dalle,
@@ -22,6 +33,7 @@ from .schedules import (
     ReduceLROnPlateau,
     gumbel_temperature,
 )
+from .telemetry import TELEMETRY, Telemetry, validate_flight_file
 
 __all__ = [
     "CACHE_DIR",
@@ -30,14 +42,21 @@ __all__ = [
     "ExponentialDecay",
     "FAULTS",
     "FaultRegistry",
+    "Gauges",
+    "Histogram",
+    "Histograms",
     "MetricsLogger",
+    "TELEMETRY",
+    "Telemetry",
     "PreemptionHandler",
     "ReduceLROnPlateau",
     "RetryPolicy",
     "Throughput",
     "counters",
     "download",
+    "gauges",
     "gumbel_temperature",
+    "histograms",
     "latest_verified_step",
     "load_checkpoint",
     "load_sharded_checkpoint",
@@ -49,5 +68,6 @@ __all__ = [
     "retry",
     "save_checkpoint",
     "save_sharded_checkpoint",
+    "validate_flight_file",
     "verify_step_dir",
 ]

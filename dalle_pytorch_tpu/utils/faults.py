@@ -34,6 +34,10 @@ Sites in use:
                  in-flight requests toward their deadlines
 ``request_cancel`` ``serving.engine``: the youngest running request is
                  cancelled mid-decode (models a client disconnect)
+``telemetry_sink_fail`` ``utils.telemetry``: the flight-recorder drain's
+                 write raises ``OSError`` N times — pins that telemetry
+                 I/O failures stay counted and contained (fail open),
+                 never propagating into the train/serve loop
 ===============  =============================================================
 
 Injection must be impossible to leave on by accident: the registry is
@@ -59,6 +63,7 @@ _VALUE_SITES = frozenset({"nan_at_step"})
 KNOWN_SITES = frozenset({
     "download", "shard_open", "shard_read", "ckpt_corrupt", "nan_at_step",
     "page_exhaust", "prefill_fail", "decode_stall", "request_cancel",
+    "telemetry_sink_fail",
 })
 
 

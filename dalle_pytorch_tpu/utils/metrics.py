@@ -9,11 +9,13 @@ instead of required, and an MFU gauge the reference lacks.
 
 from __future__ import annotations
 
+import bisect
 import json
+import math
 import sys
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 
 class Counters:
@@ -85,6 +87,134 @@ class Gauges:
 
 
 gauges = Gauges()
+
+
+class Histogram:
+    """Fixed log-spaced-bucket distribution metric — the percentile
+    companion to ``Counters``/``Gauges`` (docs/DESIGN.md §9).
+
+    Request latency, queue wait, step time, and data-wait are
+    distributions, not levels: a mean hides the p99 that pages an
+    operator. Buckets are log-spaced (``per_decade`` per factor of 10,
+    spanning [lo, hi)) so one default geometry covers microsecond span
+    overheads and hundred-second checkpoint saves with bounded relative
+    error: a reported percentile is the upper bound of its value's
+    bucket, so it is within one bucket factor (default 10^0.1 ~ 1.26x)
+    of the true order statistic. count/sum/min/max are exact.
+
+    Thread-safe; observation is a bisect + three adds (no allocation),
+    cheap enough for the serving engine's per-iteration path.
+    """
+
+    def __init__(self, lo: float = 1e-6, hi: float = 1e3,
+                 per_decade: int = 10):
+        assert 0 < lo < hi and per_decade > 0
+        n = int(math.ceil(per_decade * math.log10(hi / lo))) + 1
+        # upper bucket bounds; values above bounds[-1] land in overflow
+        self.bounds: List[float] = [
+            lo * 10.0 ** (i / per_decade) for i in range(n)
+        ]
+        self._counts = [0] * (n + 1)  # +1: overflow (+Inf) bucket
+        self._lock = threading.Lock()
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    def percentile(self, q: float) -> float:
+        """Upper bound of the bucket holding the q-th percentile value
+        (Prometheus ``histogram_quantile`` convention, conservative
+        direction). Overflow-bucket hits report the exact observed max."""
+        with self._lock:
+            if self.count == 0:
+                return 0.0
+            rank = max(1, math.ceil(q / 100.0 * self.count))
+            seen = 0
+            for i, c in enumerate(self._counts):
+                seen += c
+                if seen >= rank:
+                    if i >= len(self.bounds):  # overflow
+                        return self.max
+                    return min(self.bounds[i], self.max)
+            return self.max  # unreachable; counts sum to self.count
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": 0.0 if self.count == 0 else self.min,
+            "max": 0.0 if self.count == 0 else self.max,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
+
+    def buckets(self) -> List[Tuple[float, int]]:
+        """(upper_bound, CUMULATIVE count) pairs up to the last nonzero
+        bucket, plus the (+Inf, total) terminator — the Prometheus
+        ``_bucket{le=...}`` exposition shape."""
+        with self._lock:
+            out: List[Tuple[float, int]] = []
+            cum = 0
+            last_nonzero = max(
+                (i for i, c in enumerate(self._counts) if c), default=-1
+            )
+            for i, c in enumerate(self._counts[: len(self.bounds)]):
+                cum += c
+                if i <= last_nonzero:
+                    out.append((self.bounds[i], cum))
+            out.append((math.inf, self.count))
+            return out
+
+
+class Histograms:
+    """Process-wide named histograms, created on first observe — same
+    registry shape as ``Counters``/``Gauges`` so producers never
+    pre-declare. The span API (utils/telemetry.py) feeds ``<span>_s``
+    duration histograms here automatically."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hists: Dict[str, Histogram] = {}
+
+    def observe(self, name: str, value: float, **hist_kw) -> None:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram(**hist_kw)
+        h.observe(value)
+
+    def get(self, name: str) -> Optional[Histogram]:
+        with self._lock:
+            return self._hists.get(name)
+
+    def snapshot(self, prefix: str = "") -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            items = sorted(self._hists.items())
+        return {k: h.snapshot() for k, h in items if k.startswith(prefix)}
+
+    def items(self) -> List[Tuple[str, Histogram]]:
+        with self._lock:
+            return sorted(self._hists.items())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._hists.clear()
+
+
+histograms = Histograms()
 
 
 class MetricsLogger:
@@ -197,20 +327,32 @@ class MetricsLogger:
 
 
 class Throughput:
-    """sample_per_sec over an N-step window (train_dalle.py:621-624)."""
+    """sample_per_sec over an N-step window (train_dalle.py:621-624).
+
+    The window test counts STEPS, not samples: the old
+    ``total_samples % (samples * window)`` check silently never fired
+    once per-step sample counts varied (last-batch remainder, ragged
+    serving batches) — the running total stops being a multiple of the
+    current step's ``samples * window`` and the rate is never emitted
+    again. Samples are summed separately so the reported rate is exact
+    for ragged windows too."""
 
     def __init__(self, window: int = 10):
+        assert window > 0
         self.window = window
         self._t0 = time.perf_counter()
-        self._count = 0
+        self._steps = 0
+        self._samples = 0
 
     def update(self, samples: int) -> Optional[float]:
         """Add one step's samples; returns samples/sec once per window."""
-        self._count += samples
-        if self._count and self._count % (samples * self.window) == 0:
+        self._steps += 1
+        self._samples += samples
+        if self._steps % self.window == 0:
             now = time.perf_counter()
-            rate = samples * self.window / (now - self._t0)
+            rate = self._samples / (now - self._t0)
             self._t0 = now
+            self._samples = 0
             return rate
         return None
 

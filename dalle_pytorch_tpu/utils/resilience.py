@@ -2,7 +2,7 @@
 manifests — the host-side half of fault tolerance (the device-side half,
 the NaN step guard, lives in ``parallel/step.py``).
 
-Failure model (docs/DESIGN.md §9): on preemptible TPU pods the faults
+Failure model (docs/DESIGN.md §8): on preemptible TPU pods the faults
 that actually occur are (a) host preemption mid-epoch (SIGTERM with a
 short grace window), (b) torn checkpoint dirs from a crash mid-save,
 (c) transient network failures on downloads and shard streams, and
@@ -45,7 +45,7 @@ class RetryPolicy:
     def from_env(self, prefix: str) -> "RetryPolicy":
         """Override attempts/base_delay from ``<PREFIX>_RETRIES`` /
         ``<PREFIX>_BACKOFF`` (operators tune retry budgets per deployment
-        without code changes; docs/DESIGN.md §9 lists the knobs)."""
+        without code changes; docs/DESIGN.md §8 lists the knobs)."""
         out = self
         retries = os.environ.get(f"{prefix}_RETRIES")
         if retries is not None:
@@ -107,10 +107,19 @@ class PreemptionHandler:
     checkpoint, and exits cleanly (train_dalle.py). The first signal only
     sets the flag; a second raises ``KeyboardInterrupt`` so a stuck save
     can still be interrupted by hand. Use as a context manager —
-    original handlers are restored on exit."""
+    original handlers are restored on exit.
 
-    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+    ``on_signal(signum)`` runs inside the first signal's handler — the
+    flight-recorder drain hook (utils/telemetry.py): even if the loop
+    never reaches its emergency save (stuck step, hung collective), the
+    telemetry ring is already on disk. It must be cheap and is called
+    FAIL-OPEN: an exception is printed and swallowed, because a broken
+    observability hook must never turn a clean preemption into a crash."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 on_signal: Optional[Callable[[int], None]] = None):
         self.signals = signals
+        self.on_signal = on_signal
         self.triggered = False
         self.signum: Optional[int] = None
         self._old = {}
@@ -125,6 +134,15 @@ class PreemptionHandler:
             "checkpoint, exiting",
             file=sys.stderr,
         )
+        if self.on_signal is not None:
+            try:
+                self.on_signal(signum)
+            except Exception as e:  # fail open: observability never kills
+                print(
+                    f"on_signal hook failed (ignored): "
+                    f"{type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
 
     def __enter__(self) -> "PreemptionHandler":
         for s in self.signals:

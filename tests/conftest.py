@@ -68,7 +68,8 @@ from dalle_pytorch_tpu.utils.faults import KNOWN_SITES as _SITES  # noqa: E402
 
 assert not _FAULTS.active(), "fault registry armed at session start"
 for _site in ("page_exhaust", "prefill_fail", "decode_stall",
-              "request_cancel", "download", "ckpt_corrupt"):
+              "request_cancel", "download", "ckpt_corrupt",
+              "telemetry_sink_fail"):
     assert _site in _SITES, f"production fault site {_site!r} unregistered"
 
 import pytest  # noqa: E402
@@ -76,18 +77,24 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _reset_resilience_registries():
-    """Keep the process-wide fault registry, counters, and gauges hermetic:
-    a test that arms faults or trips metrics must not leak into the next."""
+    """Keep the process-wide fault registry, counters, gauges, histograms,
+    and telemetry hermetic: a test that arms faults or trips metrics must
+    not leak into the next."""
     from dalle_pytorch_tpu.utils.faults import FAULTS
-    from dalle_pytorch_tpu.utils.metrics import counters, gauges
+    from dalle_pytorch_tpu.utils.metrics import counters, gauges, histograms
+    from dalle_pytorch_tpu.utils.telemetry import TELEMETRY
 
     FAULTS.reset()
     counters.reset()
     gauges.reset()
+    histograms.reset()
+    TELEMETRY.reset()
     yield
     FAULTS.reset()
     counters.reset()
     gauges.reset()
+    histograms.reset()
+    TELEMETRY.reset()
 
 
 def pytest_collection_modifyitems(config, items):

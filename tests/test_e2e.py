@@ -408,6 +408,8 @@ def test_train_cli_preemption_resume(shapes_dataset, trained_vae, tmp_path):
         "--learning_rate", "1e-3",
         "--truncate_captions",
         "--dalle_output_file_name", str(out),
+        "--telemetry",
+        "--telemetry_dir", str(tmp_path / "flight"),
     ]
     env = {
         **os.environ,
@@ -442,6 +444,17 @@ def test_train_cli_preemption_resume(shapes_dataset, trained_vae, tmp_path):
     assert "emergency checkpoint" in tail, transcript
     step = latest_verified_step(f"{out}-cp")
     assert step is not None and step >= 1, transcript
+
+    # the SIGTERM must also leave a valid, parseable flight-recorder file
+    # (drained inside the signal handler, before the emergency save): the
+    # postmortem contract of docs/DESIGN.md §9
+    from dalle_pytorch_tpu.utils.telemetry import validate_flight_file
+
+    flights = sorted((tmp_path / "flight").glob("flight-*.jsonl"))
+    assert flights, f"no flight-recorder file written:\n{transcript}"
+    summary = validate_flight_file(str(flights[0]))
+    assert summary["by_name"].get("train.step"), summary
+    assert summary["by_name"].get("train.preempt_signal") == 1, summary
 
     # relaunch: the startup probe must resume from the emergency step and
     # finish; the injected NaN one step after the resume point exercises

@@ -555,7 +555,15 @@ def test_bench_serve_record():
     assert len(serve) == 1
     r = serve[0]
     for k in ("p95_ms", "p99_ms", "rejected", "preempted", "deadline_exceeded",
-              "pool_occupancy_mean", "pool_occupancy_max", "arrival_seed"):
+              "pool_occupancy_mean", "pool_occupancy_max", "arrival_seed",
+              # telemetry-era keys: histogram-sourced splits + the
+              # measured on/off overhead (ISSUE 4 acceptance)
+              "queue_p50_ms", "queue_p95_ms", "prefill_p50_ms",
+              "decode_step_p50_ms", "completed_tokens_per_sec",
+              "tokens_per_sec_telemetry_on", "telemetry_overhead_frac",
+              "telemetry_ring_dropped"):
         assert k in r, k
     assert r["completed"] + r["rejected"] + r["deadline_exceeded"] <= r["n_requests"]
     assert r["value"] > 0
+    assert r["tokens_per_sec_telemetry_on"] > 0
+    assert r["latency_source"].startswith("telemetry_histogram")

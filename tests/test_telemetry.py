@@ -1,0 +1,573 @@
+"""Unified-telemetry tests (utils/telemetry.py + metrics.py Histogram):
+span nesting on a fake clock, histogram percentile correctness vs numpy,
+ring-buffer overflow and rotation, fail-open sink faults, the flight
+recorder under a REAL SIGTERM in a subprocess, and the serving-engine
+acceptance invariant — every request produces a complete
+admit→terminal span chain whose typed outcomes sum to the engine's own
+counters under a fault-injected overload run.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.serving.types import FakeClock
+from dalle_pytorch_tpu.utils.faults import FAULTS
+from dalle_pytorch_tpu.utils.metrics import (
+    Histogram,
+    Throughput,
+    counters,
+    histograms,
+)
+from dalle_pytorch_tpu.utils.telemetry import (
+    TELEMETRY,
+    Telemetry,
+    validate_flight_file,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- histogram
+
+
+class TestHistogram:
+    def test_count_sum_min_max_exact(self):
+        rng = np.random.RandomState(0)
+        vals = np.exp(rng.randn(2000))
+        h = Histogram()
+        for v in vals:
+            h.observe(float(v))
+        assert h.count == 2000
+        np.testing.assert_allclose(h.sum, vals.sum(), rtol=1e-9)
+        assert h.min == vals.min() and h.max == vals.max()
+
+    @pytest.mark.parametrize("q", [50, 95, 99])
+    def test_percentiles_within_bucket_factor_of_numpy(self, q):
+        """The contract: a reported percentile is the upper bound of its
+        value's log-spaced bucket, so it brackets numpy's order statistic
+        within one bucket growth factor (10^0.1 ~ 1.2589) either side."""
+        rng = np.random.RandomState(q)
+        # lognormal spanning ~5 decades — the span-duration regime
+        vals = np.exp(rng.randn(5000) * 1.5 - 4)
+        h = Histogram()
+        for v in vals:
+            h.observe(float(v))
+        ratio = h.percentile(q) / np.percentile(vals, q)
+        growth = 10 ** 0.1
+        assert 1 / growth <= ratio <= growth * 1.001, (q, ratio)
+
+    def test_empty_and_overflow(self):
+        h = Histogram(lo=1e-3, hi=1.0)
+        assert h.percentile(50) == 0.0
+        assert h.snapshot()["count"] == 0
+        h.observe(50.0)  # beyond hi -> overflow bucket
+        assert h.percentile(99) == 50.0  # overflow reports the exact max
+        assert h.buckets()[-1] == (float("inf"), 1)
+
+    def test_percentile_capped_at_observed_max(self):
+        h = Histogram()
+        h.observe(0.5)
+        # bucket upper bound would be > 0.5; the cap keeps the report
+        # inside the observed range
+        assert h.percentile(99) == 0.5
+
+    def test_registry_on_demand_and_reset(self):
+        histograms.observe("t.x_s", 0.1)
+        histograms.observe("t.x_s", 0.2)
+        assert histograms.get("t.x_s").count == 2
+        assert "t.x_s" in histograms.snapshot("t.")
+        histograms.reset()
+        assert histograms.get("t.x_s") is None
+
+
+class TestThroughputWindowFix:
+    def test_fires_every_window_steps_with_ragged_samples(self):
+        """The old ``total % (samples * window)`` test silently stopped
+        firing once per-step sample counts varied (last-batch remainder,
+        ragged serving batches); steps are the window unit now."""
+        t = Throughput(window=3)
+        fired = [t.update(s) is not None for s in (4, 4, 2, 4, 3, 1, 5)]
+        assert fired == [False, False, True, False, False, True, False]
+
+    def test_rate_sums_ragged_samples(self):
+        t = Throughput(window=2)
+        t._t0 -= 1.0  # pretend the window took ~1s
+        assert t.update(3) is None
+        rate = t.update(1)
+        assert rate is not None and 3.5 < rate < 4.5  # (3+1)/~1s
+
+    def test_old_bug_scenario_constant_then_remainder(self):
+        # constant batches of 4, then a size-2 remainder: the old code
+        # never fired again after the remainder broke the multiple
+        t = Throughput(window=2)
+        seq = [4, 4, 2, 4, 4, 4]
+        fires = sum(t.update(s) is not None for s in seq)
+        assert fires == 3
+
+
+# ------------------------------------------------------- span machinery
+
+
+@pytest.fixture
+def tel(tmp_path):
+    """Private instrumented Telemetry on a FakeClock (the serving Clock
+    protocol, injected — span timing is deterministic)."""
+    t = Telemetry(clock=FakeClock(), ring_size=64)
+    t.configure(enabled=True, flight_dir=str(tmp_path / "flight"))
+    yield t
+    t.reset()
+
+
+class TestSpans:
+    def test_nesting_parents_and_fake_clock_durations(self, tel):
+        with tel.span("train.outer", step=7) as outer:
+            tel.clock.advance(1.0)
+            with tel.span("train.inner") as inner:
+                tel.clock.advance(0.25)
+            tel.event("train.mark", note="x")
+        recs = list(tel._buf)
+        by = {(r.get("name"), r["ph"]): r for r in recs}
+        assert by[("train.outer", "B")]["parent"] is None
+        assert by[("train.inner", "B")]["parent"] == outer
+        assert by[("train.mark", "I")]["parent"] == outer
+        assert by[("train.inner", "E")]["dur_s"] == pytest.approx(0.25)
+        assert by[("train.outer", "E")]["dur_s"] == pytest.approx(1.25)
+        assert by[("train.outer", "B")]["step"] == 7
+        # durations land in the <name>_s histograms
+        assert histograms.get("train.outer_s").count == 1
+        assert histograms.get("train.inner_s").sum == pytest.approx(0.25)
+
+    def test_begin_end_non_lexical(self, tel):
+        a = tel.begin("serve.request", request_id="r1")
+        b = tel.begin("serve.request", request_id="r2")
+        tel.clock.advance(2.0)
+        tel.end(b, outcome="completed")
+        tel.end(a, outcome="cancelled")
+        ends = [r for r in tel._buf if r["ph"] == "E"]
+        assert {e["outcome"] for e in ends} == {"completed", "cancelled"}
+        assert all(e["dur_s"] == pytest.approx(2.0) for e in ends)
+
+    def test_drain_and_validate(self, tel):
+        with tel.span("a"):
+            tel.event("e")
+        path = tel.drain("test")
+        s = validate_flight_file(path)
+        assert s["spans"] == 1 and s["unclosed"] == []
+        assert s["by_name"] == {"a": 2, "e": 1, "telemetry.drain": 1}
+
+    def test_unclosed_span_is_the_postmortem(self, tel):
+        tel.begin("train.step", step=3)
+        path = tel.drain("crash")
+        s = validate_flight_file(path)
+        assert s["unclosed_records"][0]["name"] == "train.step"
+        assert s["unclosed_records"][0]["step"] == 3
+
+    def test_ring_overflow_without_dir_drops_oldest_counted(self, tmp_path):
+        t = Telemetry(ring_size=8)
+        t.configure(enabled=True)  # NO flight dir -> drop, not drain
+        for i in range(20):
+            t.event("spam", i=i)
+        assert len(t._buf) == 8
+        assert t.dropped == 12
+        assert counters.get("telemetry.dropped") == 12
+        # oldest dropped: the survivors are the 8 newest
+        assert [r["i"] for r in t._buf] == list(range(12, 20))
+        t.reset()
+
+    def test_ring_full_rotates_to_flight_file(self, tel):
+        for i in range(200):  # ring_size=64 -> several rotation drains
+            tel.event("spam", i=i)
+        tel.drain("tail")
+        assert tel.dropped == 0
+        s = validate_flight_file(tel._flight_path)
+        assert s["by_name"]["spam"] == 200  # nothing lost
+
+    def test_flight_file_rotation_caps_bytes(self, tel):
+        # cap sized for exactly ONE rotation over this record volume, so
+        # both generations survive: a span whose B/E pair straddles the
+        # rotation must still balance (the validator stitches .1 first)
+        tel.configure(flight_max_bytes=12_000)
+        sid = tel.begin("serve.request", request_id="straddle")
+        for i in range(300):
+            tel.event("spam", i=i)
+            if i % 50 == 0:
+                tel.drain("tick")
+        tel.end(sid, outcome="completed")
+        tel.drain("tail")
+        assert os.path.exists(tel._flight_path + ".1")  # rotated generation
+        s = validate_flight_file(tel._flight_path)
+        assert s["unclosed"] == [] and s["orphan_ends"] == 0, s
+        assert s["by_name"]["spam"] == 300  # nothing lost across the cut
+        assert s["spans"] >= 1  # the straddling pair matched up
+
+    def test_double_rotation_orphan_end_is_counted_not_fatal(self, tel):
+        # past TWO rotations the B horizon is genuinely gone; the E must
+        # be counted as an orphan, not raise on an uncorrupted file
+        tel.configure(flight_max_bytes=1_500)
+        sid = tel.begin("serve.request", request_id="long")
+        for i in range(400):
+            tel.event("spam", i=i)
+            if i % 40 == 0:
+                tel.drain("tick")
+        tel.end(sid, outcome="completed")
+        tel.drain("tail")
+        s = validate_flight_file(tel._flight_path)
+        assert s["orphan_ends"] == 1 and s["unclosed"] == [], s
+
+    def test_disabled_is_true_noop(self, tmp_path):
+        threads_before = threading.active_count()
+        t = Telemetry()
+        with t.span("x", a=1) as sid:
+            assert sid is None
+        t.event("y")
+        assert t.begin("z") is None
+        t.end(None)
+        assert t.drain("nope") is None
+        assert not t._buf and not t._open
+        assert threading.active_count() == threads_before
+        assert not (tmp_path / "flight").exists()
+        assert histograms.get("x_s") is None
+
+
+class TestFailOpen:
+    def test_sink_fault_injectable_and_contained(self, tel):
+        FAULTS.arm("telemetry_sink_fail", 1)
+        tel.event("x")
+        assert tel.drain("faulted") is None  # swallowed, not raised
+        assert tel.sink_errors == 1
+        assert counters.get("telemetry.sink_errors") == 1
+        assert FAULTS.fired["telemetry_sink_fail"] == 1
+        # next drain works again (transient by contract)
+        tel.event("y")
+        path = tel.drain("ok")
+        assert path and validate_flight_file(path)["by_name"].get("y") == 1
+
+    def test_on_signal_hook_failure_never_raises(self):
+        from dalle_pytorch_tpu.utils.resilience import PreemptionHandler
+
+        def bad_hook(signum):
+            raise OSError("observability broke")
+
+        with PreemptionHandler(signals=(signal.SIGTERM,),
+                               on_signal=bad_hook) as p:
+            os.kill(os.getpid(), signal.SIGTERM)  # must not raise
+            assert p.triggered
+
+
+# -------------------------------------------------------- exposition
+
+
+class TestExposition:
+    def test_dump_renders_all_three_metric_kinds(self, tel):
+        counters.inc("serve.submitted", 2)
+        from dalle_pytorch_tpu.utils.metrics import gauges
+
+        gauges.set("serve.running", 1.5)
+        with tel.span("serve.decode_step"):
+            tel.clock.advance(0.01)
+        out = tel.dump()
+        assert "serve_submitted 2" in out
+        assert "serve_running 1.5" in out
+        assert 'serve_decode_step_s_bucket{le="+Inf"} 1' in out
+        assert "serve_decode_step_s_count 1" in out
+        assert 'serve_decode_step_s{quantile="0.99"}' in out
+        for line in out.splitlines():
+            if line and not line.startswith("#"):
+                float(line.rpartition(" ")[2])  # every sample line parses
+
+    def test_metrics_http_endpoint_localhost(self, tel):
+        counters.inc("serve.completed", 5)
+        port = tel.serve_metrics(0)  # 0 -> ephemeral free port
+        assert port
+        assert tel.serve_metrics(0) == port  # idempotent
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        assert "serve_completed 5" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/other", timeout=10
+            )
+        before = threading.active_count()
+        tel.configure(enabled=False)  # teardown stops the server thread
+        assert threading.active_count() < before
+
+    def test_disabled_serves_nothing(self):
+        t = Telemetry()
+        assert t.serve_metrics(0) is None
+
+
+# ------------------------------------------- host-side-only guarantee
+
+
+def test_telemetry_is_host_side_only():
+    """The span path must never touch the device: a per-token sync would
+    be a measurement that destroys what it measures. Enforced at the
+    import level — the module has no jax/jnp imports at all (everything
+    it records is a plain Python number handed in by callers)."""
+    import ast
+    import inspect
+
+    import dalle_pytorch_tpu.utils.telemetry as telemetry
+
+    tree = ast.parse(inspect.getsource(telemetry))
+    imported = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            imported |= {a.name.split(".")[0] for a in node.names}
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            imported.add((node.module or "").split(".")[0])
+    assert "jax" not in imported and "jaxlib" not in imported, imported
+    # and its metrics dependency is host-side too
+    import dalle_pytorch_tpu.utils.metrics as metrics
+
+    tree = ast.parse(inspect.getsource(metrics))
+    top_level_imports = {
+        a.name.split(".")[0]
+        for node in tree.body if isinstance(node, ast.Import)
+        for a in node.names
+    }
+    assert "jax" not in top_level_imports, top_level_imports
+
+
+# ------------------------------------------------- engine span chains
+
+
+def small_dalle():
+    from dalle_pytorch_tpu.models import DALLE
+
+    return DALLE(
+        dim=32, depth=2, num_text_tokens=16, text_seq_len=4,
+        num_image_tokens=12, image_fmap_size=2, heads=2, dim_head=8,
+        attn_types=("full",), shift_tokens=True, rotary_emb=True,
+    )
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax
+    import jax.numpy as jnp
+
+    dalle = small_dalle()
+    rng = np.random.RandomState(0)
+    text = jnp.asarray(rng.randint(1, 16, size=(2, 4)), jnp.int32)
+    image = jnp.asarray(rng.randint(0, 12, size=(2, 4)), jnp.int32)
+    params = dalle.init(jax.random.key(0), text, image)["params"]
+    return dalle, params
+
+
+@pytest.fixture(autouse=True)
+def tiny_pages(monkeypatch):
+    # page size 2 so the tiny model genuinely grows pages mid-decode —
+    # same geometry as tests/test_serving.py
+    monkeypatch.setenv("DALLE_TPU_KV_PAGE_SIZE", "2")
+    yield
+
+
+def _req(i, max_new=4, **kw):
+    from dalle_pytorch_tpu.serving import Request
+
+    rng = np.random.RandomState(100 + i)
+    kw.setdefault("seed", i)
+    return Request(
+        request_id=f"r{i}", prompt=rng.randint(1, 16, size=(4,)).astype(np.int32),
+        max_new_tokens=max_new, **kw,
+    )
+
+
+class TestEngineSpanChains:
+    def test_overload_every_request_has_typed_span_chain(self, model, tmp_path):
+        """ISSUE acceptance: under a fault-injected overload run
+        (page_exhaust + prefill_fail + bounded queue + deadlines), EVERY
+        submitted request — completed, rejected, preempted-to-cap, or
+        deadline-expired — appears in the flight recorder as a span chain
+        ending in its typed outcome, and the span-outcome counts equal the
+        engine's own accounting."""
+        from dalle_pytorch_tpu.serving import Engine, EngineConfig
+
+        TELEMETRY.configure(enabled=True, flight_dir=str(tmp_path / "fl"))
+        FAULTS.configure("page_exhaust=1,prefill_fail=1")
+        dalle, params = model
+        clock = FakeClock(step_dt=1.0)
+        eng = Engine(
+            dalle, params,
+            EngineConfig(max_batch=2, page_budget=7, queue_limit=3,
+                         prefill_attempts=2),
+            clock=clock,
+        )
+        for i in range(8):
+            eng.submit(_req(
+                i, max_new=4,
+                deadline=None if i % 2 else 40.0,
+                priority=i % 3,
+            ))
+        eng.run(max_steps=1000)
+        path = TELEMETRY.drain("test")
+        summary = validate_flight_file(path)
+        assert summary["unclosed"] == [], summary["unclosed_records"]
+        assert TELEMETRY.dropped == 0
+
+        spans = {}  # request span id -> (B rec, E rec)
+        children = {}
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("name") == "serve.request":
+                    pair = spans.setdefault(rec["id"], [None, None])
+                    pair[0 if rec["ph"] == "B" else 1] = rec
+                elif rec.get("name") == "serve.prefill" and rec["ph"] == "B":
+                    children.setdefault(rec["parent"], []).append(rec)
+
+        # one complete B..E chain per submission, each typed
+        assert len(spans) == 8
+        outcome_counts = {}
+        for sid, (b, e) in spans.items():
+            assert b is not None and e is not None, (sid, b, e)
+            assert e["outcome"], e
+            outcome_counts[e["outcome"]] = outcome_counts.get(e["outcome"], 0) + 1
+        engine_outcomes = {
+            k: v for k, v in eng.stats()["outcomes"].items() if v
+        }
+        assert outcome_counts == engine_outcomes
+        # every admitted request's prefill span is parented to ITS chain
+        admitted_span_ids = {
+            sid for sid, (b, _) in spans.items() if sid in children
+        }
+        assert len(admitted_span_ids) >= counters.get("serve.completed")
+        for sid in admitted_span_ids:
+            rid = spans[sid][0]["request_id"]
+            assert all(c["request_id"] == rid for c in children[sid])
+        # queue-wait histogram saw every admission
+        assert histograms.get("serve.queue_wait_s").count == \
+            counters.get("serve.admitted")
+
+    def test_sink_faults_never_break_the_engine(self, model, tmp_path):
+        """Observability fails open: with every drain write failing and a
+        ring small enough to force rotation mid-run, the engine still
+        completes with clean accounting — telemetry I/O errors must never
+        propagate into the serve loop."""
+        from dalle_pytorch_tpu.serving import (
+            Engine, EngineConfig, Outcome, check_accounting,
+        )
+
+        TELEMETRY.configure(
+            enabled=True, flight_dir=str(tmp_path / "fl"), ring_size=8,
+        )
+        FAULTS.arm("telemetry_sink_fail", 10_000)
+        dalle, params = model
+        eng = Engine(dalle, params, EngineConfig(max_batch=2),
+                     clock=FakeClock(step_dt=1.0))
+        for i in range(3):
+            assert eng.submit(_req(i)) is None
+        results = eng.run(max_steps=1000)
+        check_accounting(eng)
+        assert all(r.outcome is Outcome.COMPLETED for r in results.values())
+        assert TELEMETRY.sink_errors > 0  # the failure was real, and counted
+
+    def test_decode_spans_per_iteration_not_per_token(self, model, tmp_path):
+        """The span path adds ONE host-side record pair per engine
+        iteration (all active slots advance together), not one per token
+        per slot — the 'no per-token device syncs' overhead shape."""
+        from dalle_pytorch_tpu.serving import Engine, EngineConfig
+
+        TELEMETRY.configure(enabled=True, flight_dir=str(tmp_path / "fl"))
+        dalle, params = model
+        eng = Engine(dalle, params, EngineConfig(max_batch=2),
+                     clock=FakeClock(step_dt=1.0))
+        for i in range(2):
+            eng.submit(_req(i, max_new=4))
+        eng.run(max_steps=1000)
+        path = TELEMETRY.drain("t")
+        by = validate_flight_file(path)["by_name"]
+        total_tokens = 2 * 4
+        # B+E per iteration; iterations < total generated tokens because
+        # both slots advance in the same jitted step
+        assert by["serve.decode_step"] < total_tokens
+        assert by["serve.decode_step"] % 2 == 0
+
+
+# ------------------------------------------------ SIGTERM + smoke gate
+
+
+_SIGTERM_SCRIPT = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    from dalle_pytorch_tpu.utils.resilience import PreemptionHandler
+    from dalle_pytorch_tpu.utils.telemetry import TELEMETRY
+
+    TELEMETRY.configure(enabled=True, flight_dir=sys.argv[1])
+
+    def on_signal(signum):
+        TELEMETRY.event("train.preempt_signal", signum=signum)
+        TELEMETRY.drain("preempt_signal")
+
+    with PreemptionHandler(on_signal=on_signal) as p:
+        step = 0
+        print("READY", flush=True)
+        while not p.triggered:
+            with TELEMETRY.span("train.step", step=step):
+                time.sleep(0.01)
+            step += 1
+    sys.exit(0)
+""")
+
+
+def test_sigterm_drains_flight_recorder_real_signal(tmp_path):
+    """A real SIGTERM delivered to a separate process mid-step leaves a
+    valid, parseable flight-recorder file — drained inside the signal
+    handler, before any shutdown work (the kill-and-resume shape of
+    tests/test_resilience.py, applied to the telemetry contract). The
+    full-CLI version of this runs in test_e2e.py's preemption test."""
+    flight = tmp_path / "flight"
+    script = tmp_path / "loop.py"
+    script.write_text(_SIGTERM_SCRIPT.format(repo=REPO))
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(flight)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        import time
+
+        time.sleep(0.15)  # let a few steps land
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, out
+    files = sorted(flight.glob("flight-*.jsonl"))
+    assert files, out
+    summary = validate_flight_file(str(files[0]))
+    assert summary["by_name"].get("train.step", 0) >= 2, summary
+    assert summary["by_name"].get("train.preempt_signal") == 1, summary
+    # spans balance: the interrupted step's E lands via the atexit drain
+    assert summary["unclosed"] == [], summary["unclosed_records"]
+
+
+def test_telemetry_smoke_gate(tmp_path):
+    """The release gate (tools/telemetry_smoke.py): serve_smoke's
+    3-request scenario with telemetry on — flight JSONL parses, spans
+    balance, /metrics renders. Run as a real subprocess, the way a
+    release pipeline runs it."""
+    out = subprocess.run(
+        [sys.executable, "tools/telemetry_smoke.py",
+         "--dir", str(tmp_path / "fl")],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "telemetry smoke OK" in out.stderr
+    summary = json.loads(
+        [l for l in out.stdout.splitlines() if l.startswith('{"flight_file')][0]
+    )
+    assert summary["request_outcomes"] == {"completed": 3}

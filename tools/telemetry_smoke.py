@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Telemetry release gate: serve_smoke's 3-request scenario with the
+flight recorder on, then validate every observability artifact.
+
+Runs ``tools/serve_smoke.py``'s continuous-batching pass in-process with
+telemetry enabled, drains the ring, and checks the three contracts a
+release needs (docs/DESIGN.md §9):
+
+1. the flight-recorder JSONL parses line-for-line and its spans BALANCE
+   (every ``E`` matches a prior ``B``; nothing left open after a clean
+   run; zero ring drops);
+2. every serving request appears as a ``serve.request`` span chain
+   ending in a typed outcome that sums to the engine's own counters;
+3. the ``/metrics`` exposition renders (every sample line parses as
+   ``name{...} value``).
+
+Exit 0 iff all hold::
+
+    python tools/telemetry_smoke.py [--dir DIR]
+
+Composes with fault drills the same way serve_smoke does — e.g.
+``DALLE_TPU_FAULTS="prefill_fail=1" python tools/telemetry_smoke.py``
+must still pass, with the retry visible in the trace.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--dir" in argv:
+        out_dir = argv[argv.index("--dir") + 1]
+    else:
+        out_dir = tempfile.mkdtemp(prefix="dalle_telemetry_smoke_")
+
+    from dalle_pytorch_tpu.utils.metrics import counters
+    from dalle_pytorch_tpu.utils.telemetry import (
+        TELEMETRY,
+        validate_flight_file,
+    )
+
+    TELEMETRY.configure(enabled=True, flight_dir=out_dir)
+
+    import serve_smoke
+
+    rc = serve_smoke.main()
+    if rc != 0:
+        print("telemetry smoke FAILED: serve_smoke returned nonzero",
+              file=sys.stderr)
+        return 1
+
+    path = TELEMETRY.drain("smoke")
+    if path is None:
+        print("telemetry smoke FAILED: drain produced no flight file",
+              file=sys.stderr)
+        return 1
+
+    # -- 1. parse + span balance ------------------------------------------
+    summary = validate_flight_file(path)
+    ok = True
+
+    def check(cond: bool, what: str) -> None:
+        nonlocal ok
+        if not cond:
+            ok = False
+            print(f"telemetry smoke FAILED: {what}", file=sys.stderr)
+
+    check(summary["unclosed"] == [],
+          f"unbalanced spans left open: {summary['unclosed_records']}")
+    check(TELEMETRY.dropped == 0,
+          f"{TELEMETRY.dropped} ring drops in a 3-request run")
+    check(TELEMETRY.sink_errors == 0,
+          f"{TELEMETRY.sink_errors} flight-recorder sink errors")
+
+    # -- 2. one complete span chain per request, typed outcome ------------
+    n_req = counters.get("serve.submitted")
+    check(n_req >= 3, f"expected >=3 submissions, saw {n_req}")
+    outcomes: dict = {}
+    with open(path) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("name") == "serve.request" and rec["ph"] == "E":
+                check("outcome" in rec,
+                      f"serve.request span ended without outcome: {rec}")
+                o = rec.get("outcome")
+                outcomes[o] = outcomes.get(o, 0) + 1
+    check(sum(outcomes.values()) == n_req,
+          f"{n_req} submitted but {sum(outcomes.values())} request spans "
+          f"ended ({outcomes})")
+    check(outcomes.get("completed", 0) == counters.get("serve.completed"),
+          f"span outcomes {outcomes} disagree with counter "
+          f"serve.completed={counters.get('serve.completed')}")
+
+    # -- 3. the exposition renders ----------------------------------------
+    dump = TELEMETRY.dump()
+    check("serve_submitted" in dump and "_bucket{" in dump,
+          "dump() is missing serving counters or histogram buckets")
+    for line in dump.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            float(value)
+        except ValueError:
+            check(False, f"unparseable exposition line: {line!r}")
+        check(bool(name), f"unparseable exposition line: {line!r}")
+
+    print(json.dumps({
+        "flight_file": path,
+        "records": summary["records"],
+        "spans": summary["spans"],
+        "request_outcomes": outcomes,
+        "by_name": summary["by_name"],
+    }))
+    if not ok:
+        return 1
+    print(f"telemetry smoke OK: {n_req} request span chains balanced, "
+          f"{summary['records']} records, /metrics renders", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

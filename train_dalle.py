@@ -138,6 +138,22 @@ def build_parser():
                              help="global step at which the trace starts; it "
                                   "spans 3 steps (the reference profiles step "
                                   "200)")
+    train_group.add_argument("--telemetry", action="store_true",
+                             help="enable the unified telemetry layer "
+                                  "(utils/telemetry.py): train.* span/"
+                                  "histogram instrumentation and a JSONL "
+                                  "flight recorder drained on preemption/"
+                                  "exit — a crashed run leaves a postmortem "
+                                  "trace. Off by default: disabled telemetry "
+                                  "is a true no-op (no threads, no files)")
+    train_group.add_argument("--telemetry_dir", default=None, type=str,
+                             help="flight-recorder directory (default "
+                                  "<dalle_output_file_name>-telemetry)")
+    train_group.add_argument("--metrics_port", default=None, type=int,
+                             help="with --telemetry: serve the Prometheus-"
+                                  "style /metrics exposition on 127.0.0.1:"
+                                  "PORT (localhost-only by design; "
+                                  "docs/DESIGN.md §9)")
 
     model_group = parser.add_argument_group("Model settings")
     model_group.add_argument("--dim", default=512, type=int)
@@ -205,6 +221,7 @@ def main():
         PreemptionHandler,
         ReduceLROnPlateau,
         ConstantLR,
+        TELEMETRY,
         Throughput,
         counters,
         latest_verified_step,
@@ -349,6 +366,17 @@ def main():
         entity=args.wandb_entity,
     )
 
+    if args.telemetry:
+        # root-rank-guarded like MetricsLogger: one host records/exposes
+        TELEMETRY.configure(
+            enabled=runtime.is_root_worker(),
+            flight_dir=(
+                args.telemetry_dir
+                or f"{args.dalle_output_file_name}-telemetry"
+            ),
+            metrics_port=args.metrics_port,
+        )
+
     # ---- params / optimizer / compiled step ------------------------------
     text0 = jnp.zeros((1, dalle.text_seq_len), jnp.int32)
     image0 = jnp.zeros((1, dalle.image_seq_len), jnp.int32)
@@ -489,35 +517,41 @@ def main():
     def save(epoch):
         # gather is a collective — every process participates; only the
         # root writes the file
-        host_params = runtime.to_host(state.params)
-        host_opt = runtime.to_host(state.opt_state)
-        if not runtime.is_root_worker():
-            return
-        save_dalle_checkpoint(
-            ckpt_path, dalle, host_params, vae, vae_params,
-            extra={"epoch": epoch, "scheduler_state": sched.state_dict()},
-            opt_state=host_opt, step=int(state.step),
-        )
+        with TELEMETRY.span("train.ckpt_save", kind="full", epoch=epoch):
+            host_params = runtime.to_host(state.params)
+            host_opt = runtime.to_host(state.opt_state)
+            if not runtime.is_root_worker():
+                return
+            save_dalle_checkpoint(
+                ckpt_path, dalle, host_params, vae, vae_params,
+                extra={"epoch": epoch, "scheduler_state": sched.state_dict()},
+                opt_state=host_opt, step=int(state.step),
+            )
 
     def save_sharded(step, epoch, it, emergency=False):
         # step-granular, verified (manifest + commit marker): the resume
         # probe above restores exactly this. Collective — every host writes
         # its addressable shards.
-        save_sharded_checkpoint(
-            sharded_dir, step, state,
-            meta={
-                "epoch": epoch, "iter": it,
-                "scheduler_state": sched.state_dict(),
-                "emergency": emergency,
-            },
-            keep_n=args.keep_n_checkpoints,
-        )
+        with TELEMETRY.span(
+            "train.ckpt_save", kind="sharded", step=step,
+            emergency=emergency,
+        ):
+            save_sharded_checkpoint(
+                sharded_dir, step, state,
+                meta={
+                    "epoch": epoch, "iter": it,
+                    "scheduler_state": sched.state_dict(),
+                    "emergency": emergency,
+                },
+                keep_n=args.keep_n_checkpoints,
+            )
 
     # pre-flight save: fail early when misconfigured (train_dalle.py:561-563)
     save(start_epoch - 1)
 
     throughput = Throughput(window=10)
     prev_loss = None
+    step_span = None  # open train.step telemetry span (dispatch -> verdict)
     tracing = False
     # applied_steps keys the step rng by BATCH, not by dispatch attempt: a
     # batch retried after a NaN skip reuses its key, so a recovered run's
@@ -537,13 +571,19 @@ def main():
         # metadata always reflect the in-flight step's outcome. The loss
         # is NaN for ANY device-rejected step (parallel/step.py), grads
         # included.
-        nonlocal prev_loss, nan_run, applied_steps, lr, retry_batch
+        nonlocal prev_loss, nan_run, applied_steps, lr, retry_batch, step_span
         if prev_loss is None:
             return
-        if math.isfinite(float(prev_loss)):
+        loss_val = float(prev_loss)
+        # the train.step span runs dispatch -> verdict, so its duration is
+        # the REAL step latency (device included), not just host dispatch
+        TELEMETRY.end(step_span, loss=loss_val,
+                      finite=math.isfinite(loss_val))
+        step_span = None
+        if math.isfinite(loss_val):
             nan_run = 0
             applied_steps += 1
-            lr = sched.step(float(prev_loss))
+            lr = sched.step(loss_val)
         else:
             # the device already rejected the update (parallel/step.py
             # nan_guard); retry the batch — a transient NaN costs one
@@ -552,12 +592,21 @@ def main():
             # skips from before a resume.
             nan_run = int(state.consec_skipped)
             counters.inc("train.nan_skips")
+            TELEMETRY.event(
+                "train.nan_skip", step=global_step - 1,
+                consec=nan_run,
+            )
             logger.log_text(
                 f"step {global_step - 1}: non-finite loss — "
                 f"update skipped on device, retrying batch "
                 f"({nan_run}/{args.nan_abort_after})"
             )
             if nan_run >= args.nan_abort_after:
+                # drain BEFORE the emergency save: the NaN-abort
+                # postmortem must reach disk even if the save hangs
+                TELEMETRY.event("train.nan_abort", step=global_step - 1,
+                                consec=nan_run)
+                TELEMETRY.drain("nan_abort")
                 # the rejected batch's update is NOT in state: record
                 # its predecessor so a later resume replays it
                 save_sharded(int(state.step), epoch,
@@ -571,7 +620,15 @@ def main():
             retry_batch = last_fed
         prev_loss = None
 
-    with PreemptionHandler() as preempt:
+    def on_preempt_signal(signum):
+        # flight recorder to disk INSIDE the signal handler: even if the
+        # in-flight step or the emergency save below hangs, the run's last
+        # seconds are already on disk (fail-open; utils/telemetry.py)
+        TELEMETRY.event("train.preempt_signal", signum=signum,
+                        step=global_step)
+        TELEMETRY.drain("preempt_signal")
+
+    with PreemptionHandler(on_signal=on_preempt_signal) as preempt:
         for epoch in range(start_epoch, args.epochs):
             if hasattr(loader, "epoch"):
                 loader.epoch = epoch  # keep shuffle order aligned on resume
@@ -589,15 +646,20 @@ def main():
                 # pipeline is one deep by design — only batch prep
                 # overlaps. (Exhaustion doesn't end the epoch yet: the
                 # final dispatch's verdict may still demand a retry.)
-                while nxt is None and not exhausted:
-                    try:
-                        cand = next(batches)
-                    except StopIteration:
-                        exhausted = True
-                        break
-                    if epoch == resume_epoch and cand[0] <= resume_iter:
-                        continue  # consumed before the preemption
-                    nxt = cand
+                if nxt is None and not exhausted:
+                    # host-side stall waiting on the data path — the
+                    # data-wait vs step split the percentile histograms
+                    # decompose (docs/DESIGN.md §9)
+                    with TELEMETRY.span("train.data_wait", epoch=epoch):
+                        while nxt is None and not exhausted:
+                            try:
+                                cand = next(batches)
+                            except StopIteration:
+                                exhausted = True
+                                break
+                            if epoch == resume_epoch and cand[0] <= resume_iter:
+                                continue  # consumed before the preemption
+                            nxt = cand
 
                 process_verdict()
 
@@ -611,6 +673,12 @@ def main():
                     break
                 last_fed = (i, batch)
 
+                # train.step spans dispatch (incl. the VAE encode feeding
+                # it) through the step's VERDICT — closed in
+                # process_verdict, so its histogram is true step latency
+                step_span = TELEMETRY.begin(
+                    "train.step", step=global_step, epoch=epoch,
+                )
                 image_tokens = vae_encode(batch["image"])
                 train_batch = {
                     "text": jnp.asarray(batch["text"]),
