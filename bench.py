@@ -355,6 +355,12 @@ def bench_serve(on_cpu: bool, int8: bool = True, seed: int = 0):
         "p99_ms": pct("serve.completed_latency_s", 99),
         "queue_p50_ms": pct("serve.queue_wait_s", 50),
         "queue_p95_ms": pct("serve.queue_wait_s", 95),
+        # time-to-first-token (submit -> first image token), histogram-
+        # sourced like the other splits; observed unconditionally, so the
+        # clean telemetry-off run is the source
+        "ttft_p50_ms": pct("serve.ttft_s", 50),
+        "ttft_p95_ms": pct("serve.ttft_s", 95),
+        "ttft_p99_ms": pct("serve.ttft_s", 99),
     }
     on = run_trace(telemetry_on=True)
 
@@ -392,6 +398,145 @@ def bench_serve(on_cpu: bool, int8: bool = True, seed: int = 0):
         "mean_interarrival_s": mean_ia,
         "arrival_seed": seed,
         "max_batch": max_batch,
+        "device": jax.devices()[0].device_kind,
+    }
+
+
+def _interference_trace(dalle, params, *, prefill_chunk, steady_new,
+                        long_new, seed=0):
+    """Drive one engine through the interference scenario: one request in
+    steady decode, then a full-length prompt arrives mid-stream. Returns
+    (max decode-iteration gap in seconds over the arrival→first-token
+    window, the late request's ttft_s).
+
+    Decode iterations are detected via the ``serve.decode_steps`` counter
+    (metrics-side, always on — no telemetry dependency); the gap window is
+    anchored at the late submit and closed at its first token, so a
+    monolithic prefill shows up as one giant gap (no decode iterations
+    land inside the window) while chunked prefill bounds every gap by one
+    chunk's latency plus a decode step."""
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, Outcome, Request, check_accounting,
+    )
+    from dalle_pytorch_tpu.utils.metrics import counters
+
+    engine = Engine(dalle, params, EngineConfig(
+        max_batch=2, prefill_chunk=prefill_chunk,
+    ))
+    text_seq = dalle.text_seq_len
+    # warm every jit (monolithic prefill or the chunk widths, decode step)
+    # outside the measured window — compile time is not interference. TWO
+    # concurrent warm requests, so BOTH slot indices see their first
+    # insert/release/decode here (the per-slot .at[i] cache ops compile on
+    # first use per index)
+    for i in range(2):
+        engine.submit(Request(
+            request_id=f"__warm{i}__", prompt=np.zeros(text_seq, np.int32),
+            max_new_tokens=4, seed=0,
+        ))
+    engine.run()
+    rng = np.random.RandomState(seed)
+    vocab = min(NUM_TEXT, dalle.num_text_tokens)
+    prompts = rng.randint(1, vocab, size=(2, text_seq)).astype(np.int32)
+    engine.submit(Request(
+        request_id="steady", prompt=prompts[0],
+        max_new_tokens=steady_new, seed=1,
+    ))
+    prev = counters.get("serve.decode_steps")
+    while counters.get("serve.decode_steps") - prev < 3:
+        engine.step()  # steady request admitted and visibly decoding
+    t_sub = engine.clock.now()
+    engine.submit(Request(
+        request_id="late", prompt=prompts[1],
+        max_new_tokens=long_new, seed=2,
+    ))
+    ts = []
+    prev = counters.get("serve.decode_steps")
+    while engine.step():
+        cur = counters.get("serve.decode_steps")
+        if cur > prev:
+            ts.append(engine.clock.now())
+            prev = cur
+    check_accounting(engine)
+    for rid in ("steady", "late"):
+        assert engine.results[rid].outcome is Outcome.COMPLETED, (
+            rid, engine.results[rid]
+        )
+    ttft = engine.results["late"].ttft_s
+    window_end = t_sub + ttft
+    window = [t_sub] + [t for t in ts if t < window_end] + [window_end]
+    return float(np.max(np.diff(window))), float(ttft)
+
+
+def bench_serve_interference(on_cpu: bool, int8: bool | None = None,
+                             seed: int = 0, quick: bool = False, model=None):
+    """--serve companion: the long-prompt-arrival-during-steady-decode
+    scenario. A request decodes steadily; a max-length prompt arrives; the
+    record reports the MAX DECODE-ITERATION GAP the arrival caused — the
+    interference metric chunked prefill exists to shrink — measured twice,
+    with chunked prefill on (the headline ``value``) and with monolithic
+    prefill, plus both TTFTs. Outside ``quick`` mode the record also
+    ASSERTS the acceptance bound: the chunked gap must beat the monolithic
+    gap (which contains the whole prefill). ``model`` overrides the
+    flagship serving model (the telemetry smoke gate passes a tiny one).
+
+    ``int8`` defaults to bf16 on CPU and int8 on device: this record
+    measures SCHEDULING interference, and on CPU the int8 path pays a
+    per-call head-weight dequantization that inflates the one-position
+    final-chunk program to the same order as a whole prefill — an XLA-CPU
+    artifact the TPU serving path does not have."""
+    if int8 is None:
+        int8 = not on_cpu
+    if model is None:
+        dalle, params, _, fmap = _serving_model(on_cpu, int8)
+    else:
+        dalle, params = model
+        fmap = dalle.image_fmap_size
+    T = dalle.text_len_internal
+    chunk = max(2, T // 16)
+    steady_new = min(fmap * fmap, 6 if quick else 48)
+    long_new = min(fmap * fmap, 2 if quick else 8)
+    # a max-gap is a wall-clock order statistic, so one OS scheduling
+    # stall during the chunked trace can exceed the whole monolithic
+    # prefill; re-measure the pair on a violated margin (the structural
+    # gap — a full prefill vs one chunk — survives every clean run)
+    # instead of failing the bench on a single noisy sample
+    for attempt in range(3):
+        mono_gap, mono_ttft = _interference_trace(
+            dalle, params, prefill_chunk=None,
+            steady_new=steady_new, long_new=long_new, seed=seed,
+        )
+        chunked_gap, chunked_ttft = _interference_trace(
+            dalle, params, prefill_chunk=chunk,
+            steady_new=steady_new, long_new=long_new, seed=seed,
+        )
+        if quick or chunked_gap < mono_gap:
+            break
+    if not quick:
+        # the tentpole acceptance: with chunked prefill the decode loop
+        # never stalls for the whole prefill — the max gap is bounded by
+        # one chunk (+ a decode step), strictly below the monolithic gap
+        assert chunked_gap < mono_gap, (
+            f"chunked prefill did not shrink the decode-interference gap: "
+            f"chunked {chunked_gap * 1e3:.1f} ms >= monolithic "
+            f"{mono_gap * 1e3:.1f} ms (3 attempts)"
+        )
+    return {
+        "metric": "serve_interference_max_decode_gap_ms_batch2"
+                  + ("_int8" if int8 and model is None else ""),
+        "int8": bool(int8),
+        "value": round(chunked_gap * 1e3, 1),
+        "unit": "ms",
+        "vs_baseline": None,
+        "monolithic_max_gap_ms": round(mono_gap * 1e3, 1),
+        "gap_ratio": round(chunked_gap / mono_gap, 4) if mono_gap else None,
+        "ttft_chunked_ms": round(chunked_ttft * 1e3, 1),
+        "ttft_monolithic_ms": round(mono_ttft * 1e3, 1),
+        "prefill_chunk": chunk,
+        "n_chunks": -(-T // chunk),
+        "prompt_positions": T,
+        "steady_max_new_tokens": steady_new,
+        "arrival_seed": seed,
         "device": jax.devices()[0].device_kind,
     }
 
@@ -1055,6 +1200,7 @@ def main():
             print(json.dumps(_retry(lambda: bench_continuous_batching(on_cpu))))
         if "--serve" in only:
             print(json.dumps(_retry(lambda: bench_serve(on_cpu))))
+            print(json.dumps(_retry(lambda: bench_serve_interference(on_cpu))))
         if "--patterns" in only:
             for r in _retry(lambda: bench_sparse_patterns(on_cpu)):
                 print(json.dumps(r))

@@ -342,6 +342,7 @@ class DALLE(nn.Module):
         self,
         tokens: jnp.ndarray,
         mask: Optional[jnp.ndarray] = None,
+        image_only: bool = False,
     ) -> jnp.ndarray:
         """Process the first T text positions in ONE parallel pass, filling
         every decode cache (K/V, token-shift, gMLP gate), and return
@@ -352,6 +353,15 @@ class DALLE(nn.Module):
         those T sequential steps and runs MXU-shaped matmuls instead.
         tokens: (b, T) REMAPPED text ids (bos included), T <= text_len_internal
         static; equivalent to T sequential ``decode_step`` calls.
+
+        ``image_only`` (static) requires the block to cover the WHOLE
+        prompt (T == text_len_internal): position T is then the first
+        image position, whose logits-mask row permits exactly the image
+        vocab, so only the image-vocab head columns are computed
+        (``_head_image`` — the same measured serving optimization as
+        ``decode_step``'s flag, bit-equal to the full head's ``[ext:]``
+        slice) and (b, num_image_tokens) logits return with no mask/where
+        chain.
         """
         b, T = tokens.shape
         assert T <= self.text_len_internal, (
@@ -367,8 +377,75 @@ class DALLE(nn.Module):
             deterministic=True,
             decode=True,
         )
+        if image_only:
+            assert T == self.text_len_internal, (
+                "image_only prefill requires the full prompt: position T "
+                "must be the first image position"
+            )
+            return self._head_image(out[:, -1:])[:, 0]
         logits = self._head(out[:, -1:])[:, 0]
         mask_row = jnp.asarray(self.logits_mask_np())[T - 1 : T]
+        return jnp.where(mask_row, NEG_INF, logits)
+
+    def prefill_chunk(
+        self,
+        tokens: jnp.ndarray,
+        start: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        return_logits: bool = True,
+        image_only: bool = False,
+    ):
+        """Process text positions [start, start + c) of the prompt against
+        the ALREADY-WRITTEN decode-cache prefix — one budget-bounded slice
+        of a prefill, so a serving loop can interleave prompt processing
+        with decode iterations instead of stalling every active slot for
+        the whole monolithic ``prefill_step``.
+
+        tokens: (b, c) REMAPPED text ids (bos included) for positions
+        start..start+c; ``start`` is traced, so every chunk of one width
+        shares a compilation (widths: the configured chunk size plus at
+        most two ragged tail widths). The attention math is exactly the
+        shared block path — ``ops/attention.py:cache_block_attend`` over
+        the ``paged_kv.gather`` view of the page tables, with the chunk's
+        per-position pattern-mask rows selecting the cache prefix plus the
+        in-chunk causal block — so a sequence of ``prefill_chunk`` calls
+        covering [0, T) produces a cache BIT-identical to one
+        ``prefill_step`` over the same tokens, provided no chunk is a
+        single token (XLA's n == 1 matvec accumulates ~1 ulp differently;
+        see ``cache_block_attend``). Pinned by tests/test_chunked_prefill.
+
+        Returns (b, total_tokens) logits predicting position start + c
+        when ``return_logits`` (the final chunk of a prompt samples the
+        first image token from them, matching ``prefill_step``'s head
+        row), else None — intermediate chunks skip the head entirely.
+        ``image_only`` (static; implies return_logits) requires the chunk
+        to END the prompt (start + c == T, unassertable on the traced
+        start — callers guarantee it) and computes only the image-vocab
+        head columns, exactly like ``prefill_step``'s flag.
+        """
+        b, c = tokens.shape
+        assert c <= self.text_len_internal, (
+            f"prefill chunks cover text positions only, got {c} > "
+            f"{self.text_len_internal}"
+        )
+        start = jnp.asarray(start, jnp.int32)
+        emb = self.text_emb(tokens)
+        if not self.rotary_emb:
+            emb = emb + self.text_pos_emb(start + jnp.arange(c))[None]
+
+        out = self.transformer(
+            emb.astype(self.dtype),
+            mask=self._full_key_mask(mask, self.text_len_internal + self.image_seq_len),
+            deterministic=True,
+            decode=True,
+        )
+        if image_only:
+            return self._head_image(out[:, -1:])[:, 0]
+        if not return_logits:
+            return None
+        logits = self._head(out[:, -1:])[:, 0]
+        lm = jnp.asarray(self.logits_mask_np())
+        mask_row = jax.lax.dynamic_slice_in_dim(lm, start + c - 1, 1, axis=0)
         return jnp.where(mask_row, NEG_INF, logits)
 
     def decode_step(

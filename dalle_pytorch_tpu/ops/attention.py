@@ -113,6 +113,46 @@ def dense_attend(
     return jnp.einsum("...ij,...jd->...id", attn.astype(v.dtype), v)
 
 
+def cache_block_attend(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    allowed: jnp.ndarray,
+    stable: bool = False,
+) -> jnp.ndarray:
+    """Masked attention of an n-token query block against a W-row cache
+    view: q (b, n, h, d) pre-scaled, k_cache/v_cache any
+    (b, W, h*d)-reshapeable rank, ``allowed`` broadcastable to
+    (b, 1, n, W). Scores accumulate in f32; masked lanes contribute
+    exp(NEG_INF) = 0.
+
+    This is THE multi-token decode-block building block: monolithic
+    prefill (``DALLE.prefill_step``), CHUNKED prefill
+    (``DALLE.prefill_chunk`` — each chunk attends the already-written
+    paged-KV prefix, assembled by ``paged_kv.gather`` through the page
+    table, plus its own in-chunk causal rows of the pattern mask), and
+    the n > 1 branch of every cache format all route here through
+    ``PatternAttention._cache_attend``. One implementation means chunked
+    and monolithic prefill share every einsum, which is what makes
+    chunk-size-invariant BIT-parity achievable at all — with one measured
+    caveat: XLA lowers n == 1 blocks to a matvec whose accumulation
+    differs from the n >= 2 gemm by ~1 ulp (CPU, 2026-08), so callers
+    that pin bitwise parity must never emit 1-token blocks (the serving
+    engine merges a would-be 1-token final chunk into its predecessor)."""
+    b, n, h, d = q.shape
+    W = k_cache.shape[1]
+    scores = jnp.einsum(
+        "bnhd,blhd->bhnl", q, k_cache.reshape(b, W, h, d),
+        preferred_element_type=jnp.float32,
+    )
+    scores = jnp.where(allowed, scores, NEG_INF)
+    attn = _softmax(scores, stable)
+    return jnp.einsum(
+        "bhnl,blhd->bnhd", attn.astype(v_cache.dtype),
+        v_cache.reshape(b, W, h, d),
+    )
+
+
 class PatternAttention(nn.Module):
     """Multi-head attention with a static sparsity pattern.
 
@@ -885,16 +925,7 @@ class PatternAttention(nn.Module):
             )
             return out.reshape(b, 1, h, d)
 
-        scores = jnp.einsum(
-            "bnhd,blhd->bhnl", q, k_cache.reshape(b, W, h, d),
-            preferred_element_type=jnp.float32,
-        )
-        scores = jnp.where(allowed, scores, NEG_INF)
-        attn = _softmax(scores, self.stable)
-        return jnp.einsum(
-            "bhnl,blhd->bnhd", attn.astype(v_cache.dtype),
-            v_cache.reshape(b, W, h, d),
-        )
+        return cache_block_attend(q, k_cache, v_cache, allowed, self.stable)
 
     # Decode cost accounting (int8 serving, v5e-1, measured by trace —
     # tools/analyze_trace.py, 2026-07): of ~0.82 ms/token, the int8 weight

@@ -3,7 +3,7 @@ control, page-pool pressure handling. See engine.py for the architecture
 and docs/DESIGN.md for the failure model."""
 
 from .engine import Engine, EngineConfig, check_accounting
-from .scheduler import PagePool, Scheduler, pages_for
+from .scheduler import PagePool, Scheduler, TokenBudget, pages_for
 from .types import (
     Clock,
     EngineUnsupportedModel,
@@ -26,6 +26,7 @@ __all__ = [
     "Request",
     "RequestResult",
     "Scheduler",
+    "TokenBudget",
     "check_accounting",
     "pages_for",
 ]

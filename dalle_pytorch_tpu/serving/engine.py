@@ -2,7 +2,8 @@
 
 The request lifecycle (docs/DESIGN.md, serving failure model):
 
-    submit -> [rejected] | queued -> admitted (prefill, slot insert)
+    submit -> [rejected] | queued -> admitted (slot claimed)
+           -> prefilling (budget-bounded chunks, chunked mode)
            -> decoding (one vector-position decode_step per iteration)
            -> completed | deadline_exceeded | cancelled
            -> (page exhaustion) evicted -> requeued (aged) -> ... -> preempt_cap
@@ -17,35 +18,68 @@ steps all active slots with a single jitted vector-position
 ``prefill_fail``, ``decode_stall``, ``request_cancel``) make every failure
 path deterministic on CPU.
 
-Determinism contract (pinned by tests/test_serving.py): a request's token
-at internal position p is sampled with ``fold_in(key(seed), p)``, and all
-decode math is row-independent at fixed batch width (the jitted step always
-runs the full ``max_batch``; inactive slots compute garbage that is
-discarded, never read cross-row). Re-running an evicted request therefore
-reproduces its tokens bit-identically — preemption costs work, never
-changes output.
+Chunked prefill (``EngineConfig.prefill_chunk``): instead of one monolithic
+``_prefill_jit`` call that stalls every active decode slot for the whole
+prompt, an admitted request claims its slot in a PREFILLING state and its
+prompt is processed in fixed-size chunks (``DALLE.prefill_chunk`` against
+the request's own batch-1 paged cache), interleaved with decode iterations
+under a per-iteration token budget (``scheduler.TokenBudget``: decode
+tokens first, leftover to prefill chunks, head-of-line). Deadlines,
+cancellation, and preempt-and-requeue therefore land BETWEEN chunks —
+pages are freed the iteration the termination sweeps, not at the end of an
+uninterruptible prefill — and the ``prefill_fail`` fault fires at chunk
+granularity with retry resuming from the last completed chunk. The final
+chunk samples the first image token exactly like the monolithic path, so
+chunked and monolithic prefill are BIT-identical (the chunker never emits
+a 1-token chunk — ``ops/attention.py:cache_block_attend``'s measured
+caveat — merging such a tail into its predecessor).
+
+One-step-lookahead decode (``EngineConfig.decode_lookahead``, default on):
+iteration N+1's decode step is dispatched BEFORE iteration N's sampled
+tokens are read back — the next step's inputs are the previous step's
+still-on-device samples plus host-known positions and (seed, position)
+fold-in keys, so the host decision point stays but the device-to-host sync
+hides behind the next dispatch. Completion is count-based (fixed
+``max_new_tokens`` — the host knows a slot's budget without reading token
+values), and deadline/cancel semantics are defined AT READBACK TIME: a
+sample still in flight when its request terminates is simply dropped, and
+replay-after-eviction stays bit-identical because tokens depend only on
+the (seed, position) fold-in keys, never on when they were read.
+
+Determinism contract (pinned by tests/test_serving.py +
+tests/test_chunked_prefill.py): a request's token at internal position p
+is sampled with ``fold_in(key(seed), p)``, and all decode math is
+row-independent at fixed batch width (the jitted step always runs the
+full ``max_batch``; inactive slots compute garbage that is discarded,
+never read cross-row). Re-running an evicted request therefore reproduces
+its tokens bit-identically — preemption costs work, never changes output.
 
 Observability (docs/DESIGN.md §9): every request is one
 ``serve.request`` telemetry span — begun at submit, ended with its typed
-outcome — with ``serve.prefill``/``serve.slot_insert`` child spans, admit/
-evict/stall events, and one ``serve.decode_step`` span per engine
-iteration; queue-wait and request-latency land in ``serve.*`` histograms.
-All of it is host-side (``utils/telemetry.py`` never touches jax) and
-free when telemetry is disabled.
+outcome — with ``serve.prefill`` (cross-iteration in chunked mode, one
+``serve.prefill_chunk`` child per chunk) / ``serve.slot_insert`` child
+spans, admit/evict/stall/first_token events, and one ``serve.decode_step``
+span per engine iteration (with lookahead on, its duration covers
+dispatching step N plus reading back step N-1); queue-wait, TTFT, and
+request-latency land in ``serve.*`` histograms. All of it is host-side
+(``utils/telemetry.py`` never touches jax) and free when telemetry is
+disabled.
 
 Throughput note: this loop dispatches one jitted step per generated token
 (a host decision point between steps is the price of admission control,
-deadlines, and preemption). Single-shot batch generation without a request
-lifecycle should keep using ``models/sampling.py``'s fused scan — the CLI
-(generate.py) routes through THIS engine so serving behavior is exercised
-end-to-end, and falls back to the scan only for engine-unsupported models.
+deadlines, and preemption; lookahead hides the readback half of that
+price). Single-shot batch generation without a request lifecycle should
+keep using ``models/sampling.py``'s fused scan — the CLI (generate.py)
+routes through THIS engine so serving behavior is exercised end-to-end,
+and falls back to the scan only for engine-unsupported models.
 """
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass
 from functools import partial
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,7 +95,7 @@ from ..ops import kv_policy, paged_kv
 from ..utils.faults import FAULTS
 from ..utils.metrics import counters, gauges, histograms
 from ..utils.telemetry import TELEMETRY
-from .scheduler import Entry, PagePool, Scheduler, pages_for
+from .scheduler import Entry, PagePool, Scheduler, TokenBudget, pages_for
 from .types import (
     Clock,
     EngineUnsupportedModel,
@@ -75,9 +109,9 @@ from .types import (
 @dataclass(frozen=True)
 class EngineConfig:
     """Operator knobs. Defaults are deliberately permissive (pool = full
-    physical capacity, no degradation pressure) so a bare engine behaves
-    like plain batched decode; tests and bench tighten them to create
-    pressure."""
+    physical capacity, no degradation pressure, monolithic prefill) so a
+    bare engine behaves like plain batched decode; tests and bench tighten
+    them to create pressure."""
 
     max_batch: int = 4
     # logical page budget; None = full physical capacity (B * pages/slot)
@@ -92,34 +126,101 @@ class EngineConfig:
     preempt_priority_boost: int = 1
     prefill_attempts: int = 2
     stall_penalty_s: float = 1.0
+    # chunked prefill: prompt tokens per chunk (>= 2 — a 1-token chunk is
+    # the one block width XLA accumulates differently, breaking bit-parity
+    # with monolithic prefill; cache_block_attend). None = monolithic.
+    prefill_chunk: Optional[int] = None
+    # per-iteration token budget shared between decode tokens and prefill
+    # chunk tokens (chunked mode only). None = max_batch + prefill_chunk,
+    # i.e. every decode slot steps AND at most one chunk prefills per
+    # iteration — the max decode stall is one chunk's latency.
+    token_budget: Optional[int] = None
+    # dispatch decode step N+1 before reading back step N's samples
+    decode_lookahead: bool = True
+
+
+_PREFILL = "prefill"
+_DECODE = "decode"
 
 
 class _Slot:
-    """A running request bound to one cache row."""
+    """A running request bound to one cache row. Phase ``prefill``: the
+    request owns the slot index and its prompt pages while its chunks run
+    against a private batch-1 cache (``cache1``; ``filled`` = positions
+    written so far). Phase ``decode``: the cache row is live in the batched
+    cache and the slot participates in the vector decode step."""
 
     def __init__(self, entry: Entry, index: int, first_token: int,
-                 pos: int, admit_seq: int):
+                 pos: int, admit_seq: int, phase: str = _DECODE):
         self.entry = entry
         self.index = index
         self.tok = first_token   # last sampled token (not yet cached)
         self.pos = pos           # its internal position
         self.admit_seq = admit_seq
+        self.phase = phase
         self.cancelled = False
+        # chunked-prefill state
+        self.cache1 = None       # batch-1 cache being filled chunk by chunk
+        self.internal = None     # (1, T) remapped prompt ids on device
+        self.filled = 0          # prompt positions written so far
+        self.prefill_span: Optional[int] = None
+        # True iff this slot's next input token is still on device in the
+        # engine's pending (in-flight) sample array — the lookahead seam
+        self.tok_on_device = False
 
 
 @partial(jax.jit, static_argnums=(0, 5))
 def _prefill_jit(dalle: DALLE, params, cache, internal_text, key, k: int,
                  temperature):
     """One parallel prefill over the full text prompt + the first image
-    token sampled from its logits (same image-vocab slice + full-vocab-k
-    semantics as models/sampling.py's image_only path)."""
-    logits, mutated = dalle.apply(
+    token sampled from its logits. ``image_only`` computes just the
+    image-vocab head columns — bit-equal to slicing the full head at
+    ``[ext:]`` (models/dalle.py:_head_image) but without dequantizing the
+    text-vocab columns or running the full-vocab mask chain; with the
+    full-vocab-derived ``k`` the top-k threshold matches the reference's
+    fractional-k semantics exactly (models/sampling.py)."""
+    img, mutated = dalle.apply(
         {"params": params, "cache": cache},
         internal_text,
+        image_only=True,
         method=DALLE.prefill_step,
         mutable=["cache"],
     )
-    img = logits[:, dalle.num_text_tokens_ext:]
+    tok = jax.random.categorical(
+        key, top_k_filter(img, k=k) / temperature, axis=-1
+    )
+    return mutated["cache"], tok
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _prefill_chunk_jit(dalle: DALLE, params, cache, chunk, start):
+    """One intermediate prefill chunk: text positions [start, start+c)
+    written into the batch-1 cache; no logits (the head is skipped)."""
+    _, mutated = dalle.apply(
+        {"params": params, "cache": cache},
+        chunk, start,
+        return_logits=False,
+        method=DALLE.prefill_chunk,
+        mutable=["cache"],
+    )
+    return mutated["cache"]
+
+
+@partial(jax.jit, static_argnums=(0, 5))
+def _prefill_last_jit(dalle: DALLE, params, cache, chunk, start, k: int,
+                      key, temperature):
+    """The FINAL prefill chunk + the first image token sampled from its
+    logits — the exact head + sampling ops of ``_prefill_jit`` (same
+    image-only head columns, same full-vocab-derived k), so chunked and
+    monolithic prefill draw the same token from the same
+    ``fold_in(key(seed), T)`` key."""
+    img, mutated = dalle.apply(
+        {"params": params, "cache": cache},
+        chunk, start,
+        image_only=True,
+        method=DALLE.prefill_chunk,
+        mutable=["cache"],
+    )
     tok = jax.random.categorical(
         key, top_k_filter(img, k=k) / temperature, axis=-1
     )
@@ -156,6 +257,14 @@ class Engine:
                 "the spatial-gate history indexes by a scalar absolute "
                 "position, so per-slot ragged offsets cannot be expressed"
             )
+        if config.prefill_chunk is not None and config.prefill_chunk < 2:
+            raise ValueError(
+                f"prefill_chunk must be >= 2 (a 1-token chunk is the one "
+                f"block width XLA accumulates ~1 ulp differently, breaking "
+                f"bit-parity with monolithic prefill; "
+                f"ops/attention.py:cache_block_attend), got "
+                f"{config.prefill_chunk}"
+            )
         self.dalle = dalle
         self.params = params
         self.config = config
@@ -174,6 +283,17 @@ class Engine:
             config.queue_limit,
             preempt_priority_boost=config.preempt_priority_boost,
         )
+        if config.prefill_chunk is not None:
+            tokens = (
+                config.token_budget
+                if config.token_budget is not None
+                else config.max_batch + config.prefill_chunk
+            )
+            self.budget: Optional[TokenBudget] = TokenBudget(
+                budget=tokens, chunk=config.prefill_chunk
+            )
+        else:
+            self.budget = None
 
         B = config.max_batch
         # fixed-slot batched cache; every index leaf vectorized once
@@ -199,6 +319,16 @@ class Engine:
         self._seq = 0
         self._admit_seq = 0
         self._submitted = 0
+        # in-flight decode step awaiting readback: (device samples, slots
+        # dispatched). With lookahead on, this is read back one iteration
+        # behind its dispatch; off, it is consumed the same iteration.
+        self._pending: Optional[Tuple[jax.Array, List[_Slot]]] = None
+        # filler PRNG keys and token row, built ONCE: the per-iteration
+        # dispatch only folds keys for ACTIVE slots and scatters them over
+        # this cached base instead of rebuilding B host keys + a full
+        # jnp.stack every step (the measured per-iteration host overhead)
+        self._filler_keys = jnp.stack([jax.random.key(0)] * B)
+        self._zero_tok = jnp.zeros((B,), jnp.int32)
         # top-k count derived from the FULL vocab (reference fractional-k
         # semantics over the pre-sliced image logits; models/sampling.py)
         self.k_img = max(int((1 - config.filter_thres) * dalle.total_tokens), 1)
@@ -236,15 +366,18 @@ class Engine:
 
     def cancel(self, request_id: str) -> None:
         """Request cancellation; takes effect at the next scheduling
-        iteration (queued requests terminate without ever prefilling)."""
+        iteration (queued requests terminate without ever prefilling;
+        requests mid-chunked-prefill terminate between chunks)."""
         self._cancel_requested.add(request_id)
 
     def step(self) -> bool:
         """One scheduling iteration: terminations -> admission -> one
-        decode step. Returns False when the engine is fully idle."""
+        decode step -> budgeted prefill chunks. Returns False when the
+        engine is fully idle."""
         self._sweep_terminations()
         self._admit()
         worked = self._decode_once()
+        worked = self._advance_prefills() or worked
         self.clock.tick()
         self._publish_gauges()
         return worked or bool(self.sched) or any(self.slots)
@@ -252,9 +385,11 @@ class Engine:
     def run(self, max_steps: Optional[int] = None) -> Dict[str, RequestResult]:
         """Drive until idle. ``max_steps`` is a test/ops safety valve: the
         loop provably terminates (every iteration completes, terminates, or
-        advances some request, and admission cannot deadlock — an empty
-        engine has the whole pool free and over-pool demands were rejected
-        at submit), so hitting the valve is a bug, reported loudly."""
+        advances some request — the token budget always grants the head
+        prefill at least one chunk — and admission cannot deadlock: an
+        empty engine has the whole pool free and over-pool demands were
+        rejected at submit), so hitting the valve is a bug, reported
+        loudly."""
         steps = 0
         while self.step():
             steps += 1
@@ -269,7 +404,10 @@ class Engine:
     def stats(self) -> dict:
         return {
             "submitted": self._submitted,
-            "running": sum(bool(s) for s in self.slots),
+            "running": sum(bool(s) and s.phase == _DECODE for s in self.slots),
+            "prefilling": sum(
+                bool(s) and s.phase == _PREFILL for s in self.slots
+            ),
             "queued": len(self.sched),
             "pool_total": self.pool.total,
             "pool_used": self.pool.used,
@@ -297,20 +435,23 @@ class Engine:
             if entry is not None:
                 self._cancel_requested.discard(rid)
                 self._finish(entry, Outcome.CANCELLED, tokens=None)
-        # ... then running
+        # ... then running (mid-prefill included: the slot and its pages
+        # come back THIS iteration, between chunks)
         for slot in list(self.slots):
             if slot and slot.entry.request_id in self._cancel_requested:
                 self._cancel_requested.discard(slot.entry.request_id)
                 self._release_slot(slot)
                 self._finish(
                     slot.entry, Outcome.CANCELLED,
-                    tokens=np.asarray(slot.entry.generated, np.int32),
+                    tokens=self._partial_tokens(slot),
                 )
         # cancels naming unknown or already-finished requests (a normal
         # client race) must not accumulate forever in a long-lived engine
         self._cancel_requested &= self._live
         # deadlines: queued and running alike, checked every iteration so
         # pages come back the step the deadline passes, not at completion
+        # (and for a chunked prefill, between chunks — never only at the
+        # end of the prompt)
         for entry in self.sched.expired(now):
             self._finish(entry, Outcome.DEADLINE_EXCEEDED, tokens=None)
         for slot in list(self.slots):
@@ -319,8 +460,18 @@ class Engine:
                 self._release_slot(slot)
                 self._finish(
                     slot.entry, Outcome.DEADLINE_EXCEEDED,
-                    tokens=np.asarray(slot.entry.generated, np.int32),
+                    tokens=self._partial_tokens(slot),
                 )
+
+    @staticmethod
+    def _partial_tokens(slot: _Slot) -> Optional[np.ndarray]:
+        """Tokens delivered with a mid-flight termination: the read-back
+        prefix for a decoding slot (a sample still in flight is NOT
+        included — lookahead's at-readback-time semantics), None for a
+        slot that never finished its prefill."""
+        if slot.phase == _PREFILL:
+            return None
+        return np.asarray(slot.entry.generated, np.int32)
 
     # ---------------------------------------------------------- admission
 
@@ -347,6 +498,9 @@ class Engine:
             prompt_pages = pages_for(self.T, self.page)
             ok = self.pool.alloc(entry.request_id, prompt_pages)
             assert ok, "admission checked worst-case > prompt pages"
+            if self.config.prefill_chunk is not None:
+                self._claim_prefill_slot(entry, free[0])
+                continue
             req_span = self._req_spans.get(entry.request_id)
             try:
                 with TELEMETRY.span(
@@ -396,8 +550,39 @@ class Engine:
             self._admit_seq += 1
             self.slots[idx] = slot
             counters.inc("serve.admitted")
+            self._record_first_token(entry, now)
             if len(entry.generated) >= entry.effective_max_new:
                 self._complete(slot)
+
+    def _claim_prefill_slot(self, entry: Entry, idx: int) -> None:
+        """Chunked-mode admission: the request claims its slot and prompt
+        pages NOW; the prompt itself is processed chunk by chunk across the
+        following iterations (``_advance_prefills``)."""
+        now = self.clock.now()
+        entry.admit_time = now
+        req_span = self._req_spans.get(entry.request_id)
+        histograms.observe("serve.queue_wait_s", now - entry.submit_time)
+        TELEMETRY.event(
+            "serve.admit", request_id=entry.request_id, parent=req_span,
+            slot=idx, queue_wait_s=now - entry.submit_time,
+            clamped=entry.clamped,
+        )
+        slot = _Slot(
+            entry, idx, first_token=-1, pos=0,
+            admit_seq=self._admit_seq, phase=_PREFILL,
+        )
+        self._admit_seq += 1
+        slot.cache1 = self._fresh1
+        text = jnp.asarray(entry.request.prompt, jnp.int32)[None, :]
+        slot.internal = self.dalle.remap_text(text)
+        slot.filled = 0
+        slot.prefill_span = TELEMETRY.begin(
+            "serve.prefill",
+            request_id=entry.request_id, parent=req_span,
+            attempt=entry.prefill_attempts, chunked=True,
+        )
+        self.slots[idx] = slot
+        counters.inc("serve.admitted")
 
     def _degraded_budget(self, entry: Entry) -> tuple:
         cfg = self.config
@@ -431,59 +616,266 @@ class Engine:
         )
         return cache1, int(tok[0])
 
+    # ----------------------------------------------------- chunked prefill
+
+    def _next_chunk(self, filled: int) -> int:
+        """Width of the next prefill chunk: the configured size, except a
+        would-be 1-token TAIL is merged into this chunk (widths of 1 are
+        the one case XLA accumulates differently — cache_block_attend —
+        and bit-parity with monolithic prefill is a pinned contract)."""
+        chunk = self.config.prefill_chunk
+        c = min(chunk, self.T - filled)
+        if self.T - filled - c == 1:
+            c += 1
+        return c
+
+    def _advance_prefills(self) -> bool:
+        """Run this iteration's budgeted prefill chunks: in-progress
+        prefills are served head-of-line by effective priority, each
+        granted tokens by the ``TokenBudget`` policy after decode's share.
+        The ``prefill_fail`` fault fires PER CHUNK; a retry resumes from
+        the last completed chunk (``slot.filled`` is never rolled back),
+        and exhausting ``prefill_attempts`` is the same typed
+        ``prefill_failed`` outcome as the monolithic path."""
+        pre = [s for s in self.slots if s and s.phase == _PREFILL]
+        if not pre:
+            return False
+        pre.sort(key=lambda s: (
+            -self.sched.effective_priority(s.entry), s.admit_seq
+        ))
+        n_decode = sum(
+            1 for s in self.slots if s and s.phase == _DECODE
+        )
+        grants = self.budget.plan(n_decode, [self.T - s.filled for s in pre])
+        worked = False
+        for slot, grant in zip(pre, grants):
+            entry = slot.entry
+            req_span = self._req_spans.get(entry.request_id)
+            while grant > 0 and self.slots[slot.index] is slot:
+                c = self._next_chunk(slot.filled)
+                if FAULTS.take("prefill_fail"):
+                    counters.inc("serve.fault_prefill_fail")
+                    entry.prefill_attempts += 1
+                    counters.inc("serve.prefill_retries")
+                    TELEMETRY.event(
+                        "serve.prefill_retry", request_id=entry.request_id,
+                        parent=req_span, attempt=entry.prefill_attempts,
+                        chunk_start=slot.filled,
+                    )
+                    if entry.prefill_attempts >= self.config.prefill_attempts:
+                        self._release_slot(slot)
+                        self._finish(
+                            entry, Outcome.PREFILL_FAILED, tokens=None,
+                            detail="prefill failed after "
+                                   f"{entry.prefill_attempts} attempts "
+                                   f"({slot.filled}/{self.T} tokens "
+                                   "prefilled)",
+                        )
+                    break  # retry next iteration, from this same chunk
+                worked = True
+                counters.inc("serve.prefill_chunks")
+                final = slot.filled + c >= self.T
+                chunk = jax.lax.dynamic_slice_in_dim(
+                    slot.internal, slot.filled, c, axis=1
+                )
+                with TELEMETRY.span(
+                    "serve.prefill_chunk",
+                    request_id=entry.request_id, parent=slot.prefill_span,
+                    start=slot.filled, tokens=c,
+                ):
+                    if final:
+                        key = jax.random.fold_in(
+                            jax.random.key(entry.request.seed), self.T
+                        )
+                        slot.cache1, tok = _prefill_last_jit(
+                            self.dalle, self.params, slot.cache1, chunk,
+                            jnp.int32(slot.filled), self.k_img, key,
+                            self.config.temperature,
+                        )
+                        tok0 = int(tok[0])
+                    else:
+                        slot.cache1 = _prefill_chunk_jit(
+                            self.dalle, self.params, slot.cache1, chunk,
+                            jnp.int32(slot.filled),
+                        )
+                        # sync the chunk before leaving its span: chunks
+                        # are the budgeted unit of work, so letting their
+                        # futures pile up behind the per-iteration decode
+                        # readback would re-create exactly the unbounded
+                        # decode stall this scheduler exists to prevent
+                        # (the backlog drains in one spike at the next
+                        # hard sync — measured on CPU as a final-chunk
+                        # iteration costing several chunks' latency). The
+                        # sync also makes serve.prefill_chunk_s a real
+                        # chunk-latency histogram.
+                        jax.block_until_ready(slot.cache1)
+                slot.filled += c
+                grant -= c
+                if final:
+                    self._finish_prefill(slot, tok0)
+                    break
+        return worked
+
+    def _finish_prefill(self, slot: _Slot, tok0: int) -> None:
+        """The final chunk sampled the first image token: land the batch-1
+        cache in the slot's row of the batched cache and transition to the
+        decode phase — the chunked analog of the monolithic admission
+        tail."""
+        entry = slot.entry
+        now = self.clock.now()
+        req_span = self._req_spans.get(entry.request_id)
+        TELEMETRY.end(slot.prefill_span, outcome="completed")
+        slot.prefill_span = None
+        with TELEMETRY.span(
+            "serve.slot_insert",
+            request_id=entry.request_id, parent=req_span, slot=slot.index,
+        ):
+            self.cache = insert_decode_cache(self.cache, slot.cache1, slot.index)
+        slot.cache1 = None
+        slot.internal = None
+        entry.generated = [tok0]
+        slot.tok = tok0
+        slot.pos = self.T
+        slot.phase = _DECODE
+        slot.tok_on_device = False
+        self._record_first_token(entry, now)
+        if len(entry.generated) >= entry.effective_max_new:
+            self._complete(slot)
+
+    def _record_first_token(self, entry: Entry, now: float) -> None:
+        """TTFT bookkeeping: set once per request (a preempted request's
+        replay regenerates the token — the client-visible first token was
+        the FIRST production)."""
+        if entry.ttft_s is not None:
+            return
+        entry.ttft_s = now - entry.submit_time
+        histograms.observe("serve.ttft_s", entry.ttft_s)
+        TELEMETRY.event(
+            "serve.first_token", request_id=entry.request_id,
+            parent=self._req_spans.get(entry.request_id),
+            ttft_s=entry.ttft_s,
+        )
+
     # -------------------------------------------------------------- decode
 
     def _decode_once(self) -> bool:
+        cfg = self.config
         if FAULTS.take("decode_stall"):
             counters.inc("serve.fault_decode_stall")
             TELEMETRY.event(
-                "serve.decode_stall", penalty_s=self.config.stall_penalty_s
+                "serve.decode_stall", penalty_s=cfg.stall_penalty_s
             )
-            self.clock.advance(self.config.stall_penalty_s)
-        active = [s for s in self.slots if s]
-        if not active:
-            return False
+            self.clock.advance(cfg.stall_penalty_s)
+        pending = self._pending
+        in_flight = (
+            set() if pending is None else {id(s) for s in pending[1]}
+        )
+        # a slot whose in-flight sample will hit its budget at readback is
+        # NOT dispatched again (completion is count-based: the host knows
+        # the tally without reading token values — the lookahead seam)
+        dispatchable = [
+            s for s in self.slots
+            if s and s.phase == _DECODE
+            and len(s.entry.generated) + (1 if id(s) in in_flight else 0)
+            < s.entry.effective_max_new
+        ]
         # page growth: writing position ``pos`` needs pages [0, pos//page];
         # allocate on boundary crossings, preempting on failure
-        for slot in sorted(active, key=lambda s: -self.sched.effective_priority(s.entry)):
+        for slot in sorted(
+            dispatchable,
+            key=lambda s: -self.sched.effective_priority(s.entry),
+        ):
             if self.slots[slot.index] is not slot:
                 continue  # evicted by a previous iteration of this loop
             needed = slot.pos // self.page + 1
             deficit = needed - self.pool.held(slot.entry.request_id)
             if deficit > 0 and not self._alloc_or_preempt(slot, deficit):
                 continue  # the requester itself was evicted
-        active = [s for s in self.slots if s]
-        if not active:
-            return True
+        dispatchable = [s for s in dispatchable if self.slots[s.index] is s]
+        worked = False
+        # ONE span per dispatched decode step; with lookahead it brackets
+        # the dispatch of step N AND the (synchronizing) readback of step
+        # N-1 — opened/closed host-side, adding no device syncs of its
+        # own. A trailing readback with nothing left to dispatch drains
+        # outside any span.
+        with TELEMETRY.span(
+            "serve.decode_step",
+            n_active=len(dispatchable), lookahead=cfg.decode_lookahead,
+        ) if dispatchable else contextlib.nullcontext():
+            new_pending = None
+            if dispatchable:
+                worked = True
+                counters.inc("serve.decode_steps")
+                new_pending = self._dispatch_decode(dispatchable, pending)
+            if cfg.decode_lookahead:
+                prev, self._pending = pending, new_pending
+            else:
+                prev, self._pending = new_pending, None
+            if prev is not None:
+                worked = True
+                self._readback(prev)
+        return worked
+
+    def _dispatch_decode(self, dispatchable: List[_Slot], pending):
+        """Dispatch one vector-position decode step. Input tokens come
+        from the previous step's still-on-device samples where possible
+        (``tok_on_device``); only host-decided tokens (a fresh prefill's
+        first token, a replay) are scattered in. The per-slot fold-in keys
+        are computed for ACTIVE slots only and scattered over the cached
+        filler-key array."""
         B = self.config.max_batch
-        # ONE span per engine iteration (one generated token per active
-        # slot), opened/closed host-side around the already-synchronizing
-        # np.asarray — the span itself adds no device syncs
-        with TELEMETRY.span("serve.decode_step", n_active=len(active)):
-            tok = np.zeros((B,), np.int32)
-            pos = np.zeros((B,), np.int32)
-            keys = [jax.random.key(0)] * B
-            for s in active:
-                tok[s.index] = s.tok
-                pos[s.index] = s.pos
-                # the token at position pos+1 is drawn from this key — pure
-                # (seed, position) addressing, independent of batch history
-                keys[s.index] = jax.random.fold_in(
-                    jax.random.key(s.entry.request.seed), s.pos + 1
-                )
-            self.cache, samples = _decode_jit(
-                self.dalle, self.params, self.cache,
-                jnp.asarray(tok), jnp.asarray(pos), jnp.stack(keys),
-                self.k_img, self.config.temperature,
+        pos = np.zeros((B,), np.int32)
+        host_idx: List[int] = []
+        host_tok: List[int] = []
+        key_idx: List[int] = []
+        key_list = []
+        for s in dispatchable:
+            pos[s.index] = s.pos
+            key_idx.append(s.index)
+            # the token at position pos+1 is drawn from this key — pure
+            # (seed, position) addressing, independent of batch history
+            key_list.append(jax.random.fold_in(
+                jax.random.key(s.entry.request.seed), s.pos + 1
+            ))
+            if pending is None or not s.tok_on_device:
+                host_idx.append(s.index)
+                host_tok.append(s.tok)
+        tok = pending[0] if pending is not None else self._zero_tok
+        if host_idx:
+            tok = tok.at[jnp.asarray(host_idx)].set(
+                jnp.asarray(host_tok, jnp.int32)
             )
-            samples = np.asarray(samples)
-        for s in active:
-            s.tok = int(samples[s.index])
+        keys = self._filler_keys.at[jnp.asarray(key_idx)].set(
+            jnp.stack(key_list)
+        )
+        self.cache, samples = _decode_jit(
+            self.dalle, self.params, self.cache,
+            tok, jnp.asarray(pos), keys,
+            self.k_img, self.config.temperature,
+        )
+        for s in self.slots:
+            if s is not None and s.phase == _DECODE:
+                s.tok_on_device = False
+        for s in dispatchable:
             s.pos += 1
+            s.tok_on_device = True
+        return samples, list(dispatchable)
+
+    def _readback(self, prev) -> None:
+        """Read back one dispatched step's samples (the only host<-device
+        sync of the loop) and apply its host decisions: record tokens,
+        complete slots that hit their budget. Samples belonging to slots
+        terminated or evicted since dispatch are dropped here — deadline /
+        cancel semantics are defined at readback time."""
+        samples, slots = prev
+        samples = np.asarray(samples)
+        for s in slots:
+            if self.slots[s.index] is not s:
+                continue  # terminated/evicted while the step was in flight
+            s.tok = int(samples[s.index])
             s.entry.generated.append(s.tok)
             if len(s.entry.generated) >= s.entry.effective_max_new:
                 self._complete(s)
-        return True
 
     def _alloc_or_preempt(self, slot: _Slot, n: int) -> bool:
         """Allocate ``n`` pages for ``slot``, evicting victims until it
@@ -503,7 +895,8 @@ class Engine:
     def _pick_victim(self) -> Optional[_Slot]:
         """Lowest effective priority dies first; within a priority the
         YOUNGEST admission dies (it has the least sunk prefill+decode work
-        and the shortest replay)."""
+        and the shortest replay). Mid-prefill slots are eligible victims —
+        their pages free between chunks like any other eviction."""
         running = [s for s in self.slots if s]
         if not running:
             return None
@@ -540,14 +933,27 @@ class Engine:
     # ----------------------------------------------------------- plumbing
 
     def _release_slot(self, slot: _Slot) -> None:
-        """Return the slot's pages and reset its cache row to pristine:
-        page pools zeroed (``paged_kv.reset_rows`` — stale K/V must not
-        leak to the next tenant), page tables back to identity
+        """Return the slot's pages; for a DECODING slot additionally reset
+        its batched-cache row to pristine: page pools zeroed
+        (``paged_kv.reset_rows`` — stale K/V must not leak to the next
+        tenant), page tables back to identity
         (``paged_kv.reset_table_rows``), and every other per-row leaf
         (indices, shift history) zeroed — the catch-all default, so a new
-        cache leaf is reset-safe by construction."""
+        cache leaf is reset-safe by construction. A PREFILLING slot never
+        wrote its batched row (its chunks live in a private batch-1 cache,
+        dropped here), and ``insert_decode_cache`` overwrites every leaf
+        of the row at the next admission, so no device work is needed."""
         self.pool.free_all(slot.entry.request_id)
         idx = slot.index
+        if slot.phase == _PREFILL:
+            TELEMETRY.end(
+                slot.prefill_span, outcome="aborted", filled=slot.filled
+            )
+            slot.prefill_span = None
+            slot.cache1 = None
+            slot.internal = None
+            self.slots[idx] = None
+            return
 
         def fn(path, x):
             key = getattr(path[-1], "key", None)
@@ -618,13 +1024,21 @@ class Engine:
                 None if entry.admit_time is None
                 else entry.admit_time - entry.submit_time
             ),
+            ttft_s=entry.ttft_s,
             total_latency_s=now - entry.submit_time,
             detail=detail,
         )
 
     def _publish_gauges(self) -> None:
         gauges.set("serve.pool_occupancy", self.pool.occupancy)
-        gauges.set("serve.running", sum(bool(s) for s in self.slots))
+        gauges.set(
+            "serve.running",
+            sum(bool(s) and s.phase == _DECODE for s in self.slots),
+        )
+        gauges.set(
+            "serve.prefilling",
+            sum(bool(s) and s.phase == _PREFILL for s in self.slots),
+        )
         gauges.set("serve.queued", len(self.sched))
 
 
@@ -639,6 +1053,9 @@ def check_accounting(engine: Engine) -> None:
     assert not any(engine.slots) and not len(engine.sched), (
         "engine not idle"
     )
+    assert engine._pending is None or not any(
+        engine.slots[s.index] is s for s in engine._pending[1]
+    ), "engine idle with a live in-flight decode step"
     assert len(engine.results) == engine._submitted, (
         f"{engine._submitted} submitted but {len(engine.results)} results"
     )

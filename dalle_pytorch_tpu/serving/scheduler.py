@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import heapq
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 from .types import Request
 
@@ -30,6 +30,61 @@ def pages_for(n_positions: int, page_size: int) -> int:
     """Pages covering ``n_positions`` written cache rows (ceil; 0 -> 0)."""
     assert page_size > 0, page_size
     return -(-max(0, n_positions) // page_size)
+
+
+@dataclass(frozen=True)
+class TokenBudget:
+    """Per-iteration token budget shared between decode tokens and
+    prefill-chunk tokens — the policy that bounds how long any single
+    engine iteration can stall the decode loop (the chunked-prefill
+    interference contract: with the default budget of
+    ``max_batch + chunk``, the max decode-iteration gap while a prompt
+    prefills is one chunk's latency, not the whole prefill's).
+
+    Decode is charged first (one token per active slot — a decode
+    iteration is never skipped to make room for prefill work); the
+    leftover budget goes to in-progress prefills head-of-line in
+    scheduling order, granted in CHUNK quanta so chunk widths stay
+    trace-stable. Forward progress is guaranteed: when prefills exist but
+    the budget is exhausted by decode alone, the head prefill still gets
+    one chunk — a budget below ``n_decode + chunk`` throttles prefill to
+    that floor rather than deadlocking it. ``budget=None`` is unbounded
+    (every in-progress prefill runs to completion each iteration — the
+    chunk state machine without the interleaving guarantee)."""
+
+    budget: Optional[int]
+    chunk: int
+
+    def __post_init__(self):
+        assert self.chunk >= 1, self.chunk
+        assert self.budget is None or self.budget >= 1, self.budget
+
+    def plan(self, n_decode: int, prefill_remaining: Sequence[int]) -> List[int]:
+        """Token grants for each in-progress prefill this iteration.
+
+        ``prefill_remaining``: unprocessed prompt tokens per prefill, in
+        scheduling order (highest effective priority first). Returns one
+        grant per entry; grants are multiples of ``chunk`` except a
+        smaller final tail. The engine may widen a granted final chunk by
+        one token (its 1-token-tail merge, engine._next_chunk) — the
+        budget is a scheduling bound, not an exact meter."""
+        grants = [0] * len(prefill_remaining)
+        if not prefill_remaining:
+            return grants
+        if self.budget is None:
+            return list(prefill_remaining)
+        left = self.budget - n_decode
+        granted_any = False
+        for i, rem in enumerate(prefill_remaining):
+            while rem > 0:
+                c = min(self.chunk, rem)
+                if left < c and granted_any:
+                    return grants
+                grants[i] += c
+                rem -= c
+                left -= c
+                granted_any = True
+        return grants
 
 
 class PagePool:
@@ -84,6 +139,11 @@ class Entry:
     effective_max_new: int = 0
     clamped: bool = False
     admit_time: Optional[float] = None
+    # time from submit to the FIRST time a first token was produced — set
+    # once, surviving preempt-and-requeue (replay regenerates the token
+    # bit-identically; the client-visible first-token latency is the
+    # first production, not the replay)
+    ttft_s: Optional[float] = None
     generated: List[int] = field(default_factory=list)
     # whether this queue residency counts against the client-facing bound
     # (True for fresh submissions, False for preemption/retry requeues)

@@ -79,6 +79,11 @@ class RequestResult:
     # response CARRIES the clamp instead of silently under-generating
     clamped_max_new_tokens: Optional[int] = None
     queue_latency_s: Optional[float] = None
+    # time-to-first-token: submit -> the first image token first sampled
+    # (at prefill completion). Set once; a preempted-and-replayed request
+    # keeps its ORIGINAL ttft (replay regenerates the same token), and a
+    # request that never finished a prefill reports None.
+    ttft_s: Optional[float] = None
     total_latency_s: Optional[float] = None
     detail: str = ""
 
@@ -94,6 +99,7 @@ class RequestResult:
             "prefill_attempts": self.prefill_attempts,
             "clamped_max_new_tokens": self.clamped_max_new_tokens,
             "queue_latency_s": self.queue_latency_s,
+            "ttft_s": self.ttft_s,
             "total_latency_s": self.total_latency_s,
             "detail": self.detail,
         }

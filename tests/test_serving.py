@@ -417,7 +417,8 @@ class TestDegradationOverload:
         eng.step()
         snap = gauges.snapshot("serve.")
         assert set(snap) == {
-            "serve.pool_occupancy", "serve.running", "serve.queued"
+            "serve.pool_occupancy", "serve.running", "serve.prefilling",
+            "serve.queued",
         }
         assert snap["serve.running"] == 1
         eng.run(max_steps=200)
@@ -561,9 +562,20 @@ def test_bench_serve_record():
               "queue_p50_ms", "queue_p95_ms", "prefill_p50_ms",
               "decode_step_p50_ms", "completed_tokens_per_sec",
               "tokens_per_sec_telemetry_on", "telemetry_overhead_frac",
-              "telemetry_ring_dropped"):
+              "telemetry_ring_dropped",
+              # chunked-prefill era: TTFT percentiles ride the same
+              # histogram mechanism as the other splits
+              "ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms"):
         assert k in r, k
     assert r["completed"] + r["rejected"] + r["deadline_exceeded"] <= r["n_requests"]
     assert r["value"] > 0
     assert r["tokens_per_sec_telemetry_on"] > 0
     assert r["latency_source"].startswith("telemetry_histogram")
+    # the interference scenario record rides the same --serve invocation;
+    # its emission implies the in-bench acceptance assert held (chunked
+    # max decode gap < monolithic)
+    inter = [r for r in recs if r["metric"].startswith("serve_interference")]
+    assert len(inter) == 1
+    assert inter[0]["value"] > 0
+    assert inter[0]["value"] < inter[0]["monolithic_max_gap_ms"]
+    assert inter[0]["n_chunks"] > 1

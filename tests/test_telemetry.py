@@ -556,9 +556,11 @@ def test_sigterm_drains_flight_recorder_real_signal(tmp_path):
 
 def test_telemetry_smoke_gate(tmp_path):
     """The release gate (tools/telemetry_smoke.py): serve_smoke's
-    3-request scenario with telemetry on — flight JSONL parses, spans
-    balance, /metrics renders. Run as a real subprocess, the way a
-    release pipeline runs it."""
+    3-request scenario — run CHUNKED and monolithic, plus the mid-prefill
+    deadline drill and the interference scenario — with telemetry on:
+    flight JSONL parses, spans balance (per-chunk spans included),
+    /metrics renders. Run as a real subprocess, the way a release
+    pipeline runs it."""
     out = subprocess.run(
         [sys.executable, "tools/telemetry_smoke.py",
          "--dir", str(tmp_path / "fl")],
@@ -570,4 +572,9 @@ def test_telemetry_smoke_gate(tmp_path):
     summary = json.loads(
         [l for l in out.stdout.splitlines() if l.startswith('{"flight_file')][0]
     )
-    assert summary["request_outcomes"] == {"completed": 3}
+    # 3 chunked + 3 monolithic completions, 1 mid-prefill deadline drill
+    assert summary["request_outcomes"] == {
+        "completed": 6, "deadline_exceeded": 1,
+    }
+    assert summary["prefill_chunk_spans"] >= 2
+    assert summary["interference_max_gap_ms"] > 0

@@ -1,17 +1,22 @@
 #!/usr/bin/env python
-"""Serving-engine release gate: a 3-request continuous-batching pass on CPU.
+"""Serving-engine release gate: continuous-batching passes on CPU.
 
-Builds a tiny DALLE in-process (no checkpoint needed), submits three
-requests through the full engine lifecycle (admit -> prefill -> slot
-insert -> vector-position decode -> complete), and verifies the accounting
-invariant: every request ends in a typed outcome, all pages return to the
-pool. Exit 0 iff all three COMPLETE — the gate a release pipeline runs
-before shipping a serving build::
+Builds a tiny DALLE in-process (no checkpoint needed) and drives the full
+engine lifecycle twice — once with CHUNKED prefill (budget-bounded
+prompt chunks interleaved with decode; the production serving shape) and
+once monolithic — verifying the accounting invariant each time: every
+request ends in a typed outcome, all pages return to the pool, and the
+two modes produce BIT-identical tokens. A third, deterministic drill
+(FakeClock) lands a deadline MID-PREFILL and asserts the pages come back
+that iteration. Exit 0 iff all requests of both passes COMPLETE and the
+drill terminates typed — the gate a release pipeline runs before
+shipping a serving build::
 
     python tools/serve_smoke.py
 
-Composes with the fault registry for pipeline fault drills (the injected
-fault must be absorbed, e.g. a transient prefill failure retried)::
+Composes with the fault registry for pipeline fault drills. The chunked
+pass runs FIRST, so an armed ``prefill_fail`` fires at CHUNK granularity
+and the retry must resume from the last completed chunk::
 
     DALLE_TPU_FAULTS="prefill_fail=1" python tools/serve_smoke.py
 """
@@ -29,14 +34,13 @@ sys.path.insert(0, str(REPO))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def main() -> int:
+def build_tiny_model():
+    """The gate's model: tiny, rotary, shift-tokens — built in-process so
+    the gate needs no checkpoint. Shared with tools/telemetry_smoke.py."""
     import jax
     import numpy as np
 
     from dalle_pytorch_tpu.models import DALLE
-    from dalle_pytorch_tpu.serving import (
-        Engine, EngineConfig, Outcome, Request, check_accounting,
-    )
 
     dalle = DALLE(
         dim=32, depth=2, num_text_tokens=16, text_seq_len=4,
@@ -47,29 +51,87 @@ def main() -> int:
     text = rng.randint(1, 16, size=(1, 4)).astype(np.int32)
     image = rng.randint(0, 12, size=(1, 4)).astype(np.int32)
     params = dalle.init(jax.random.key(0), text, image)["params"]
+    return dalle, params
 
-    engine = Engine(dalle, params, EngineConfig(max_batch=2))
-    for i in range(3):
-        rejected = engine.submit(Request(
-            request_id=f"smoke{i}",
-            prompt=rng.randint(1, 16, size=(4,)).astype(np.int32),
-            max_new_tokens=dalle.image_seq_len,
-            seed=i,
-        ))
-        assert rejected is None, rejected
-    results = engine.run(max_steps=1000)
-    check_accounting(engine)
+
+def main() -> int:
+    import numpy as np
+
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, FakeClock, Outcome, Request, check_accounting,
+    )
+
+    dalle, params = build_tiny_model()
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(1, 16, size=(4,)).astype(np.int32) for _ in range(3)]
+
+    def run_pass(label: str, **cfg_kw) -> dict:
+        engine = Engine(dalle, params, EngineConfig(max_batch=2, **cfg_kw))
+        for i in range(3):
+            rejected = engine.submit(Request(
+                request_id=f"smoke{i}",
+                prompt=prompts[i],
+                max_new_tokens=dalle.image_seq_len,
+                seed=i,
+            ))
+            assert rejected is None, rejected
+        results = engine.run(max_steps=1000)
+        check_accounting(engine)
+        for rid in sorted(results):
+            print(json.dumps({"pass": label, **results[rid].to_json()}))
+        print(json.dumps({"pass": label, "stats": engine.stats()}))
+        return results
+
+    # chunked first: an env-armed prefill_fail fires at CHUNK granularity
+    # and must be absorbed by the resume-from-last-chunk retry
+    chunked = run_pass("chunked", prefill_chunk=2)
+    mono = run_pass("monolithic")
 
     ok = True
-    for rid in sorted(results):
-        r = results[rid]
-        print(json.dumps(r.to_json()))
-        ok = ok and r.outcome is Outcome.COMPLETED
-    print(json.dumps({"stats": engine.stats()}))
+    for rid in sorted(mono):
+        ok = ok and mono[rid].outcome is Outcome.COMPLETED
+        ok = ok and chunked[rid].outcome is Outcome.COMPLETED
+        if not np.array_equal(
+            np.asarray(mono[rid].tokens), np.asarray(chunked[rid].tokens)
+        ):
+            ok = False
+            print(f"serve smoke FAILED: {rid} chunked tokens diverge from "
+                  "monolithic", file=sys.stderr)
+
+    # mid-prefill deadline drill: token_budget=1 throttles prefill to one
+    # chunk per iteration (the forward-progress floor), the FakeClock makes
+    # "expires mid-prefill" an exact step count, and the pages must be back
+    # the iteration the deadline sweeps — never held to the end of the
+    # prompt the way a monolithic prefill would
+    drill = Engine(
+        dalle, params,
+        EngineConfig(max_batch=2, prefill_chunk=2, token_budget=1),
+        clock=FakeClock(step_dt=1.0),
+    )
+    assert drill.submit(Request(
+        request_id="drill", prompt=prompts[0],
+        max_new_tokens=dalle.image_seq_len, seed=0, deadline=0.5,
+    )) is None
+    drill.run(max_steps=100)
+    check_accounting(drill)
+    res = drill.results["drill"]
+    print(json.dumps({"pass": "mid_prefill_deadline", **res.to_json()}))
+    if res.outcome is not Outcome.DEADLINE_EXCEEDED or res.tokens is not None:
+        ok = False
+        print("serve smoke FAILED: mid-prefill deadline drill did not "
+              f"terminate typed mid-prefill ({res.outcome.value})",
+              file=sys.stderr)
+    if drill.pool.used != 0:
+        ok = False
+        print("serve smoke FAILED: mid-prefill termination leaked "
+              f"{drill.pool.used} pages", file=sys.stderr)
+
     if not ok:
         print("serve smoke FAILED: not every request completed", file=sys.stderr)
         return 1
-    print("serve smoke OK: 3/3 completed, pool drained", file=sys.stderr)
+    print("serve smoke OK: 3/3 completed chunked AND monolithic "
+          "(bit-identical), mid-prefill deadline drill typed, pool drained",
+          file=sys.stderr)
     return 0
 
 

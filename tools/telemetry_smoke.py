@@ -10,9 +10,15 @@ release needs (docs/DESIGN.md §9):
    (every ``E`` matches a prior ``B``; nothing left open after a clean
    run; zero ring drops);
 2. every serving request appears as a ``serve.request`` span chain
-   ending in a typed outcome that sums to the engine's own counters;
+   ending in a typed outcome that sums to the engine's own counters —
+   including the CHUNKED-prefill pass, whose ``serve.prefill_chunk``
+   spans and ``serve.ttft_s`` histogram must be present;
 3. the ``/metrics`` exposition renders (every sample line parses as
-   ``name{...} value``).
+   ``name{...} value``);
+4. the long-prompt-arrival-during-steady-decode interference scenario
+   (bench.py:bench_serve_interference, quick mode on the tiny model)
+   runs with the recorder on, its max-decode-gap metric is finite, and
+   the spans it adds still balance.
 
 Exit 0 iff all hold::
 
@@ -103,6 +109,18 @@ def main(argv=None) -> int:
           f"span outcomes {outcomes} disagree with counter "
           f"serve.completed={counters.get('serve.completed')}")
 
+    # chunked-prefill observability: serve_smoke's chunked pass must have
+    # left per-chunk spans and the TTFT histogram behind. Count via the
+    # validator's by_name (B+E records, rotated generations included)
+    # rather than re-parsing the file by hand.
+    n_chunk_spans = summary["by_name"].get("serve.prefill_chunk", 0) // 2
+    check(n_chunk_spans >= 2,
+          f"expected >=2 serve.prefill_chunk spans from the chunked pass, "
+          f"saw {n_chunk_spans}")
+    from dalle_pytorch_tpu.utils.metrics import histograms
+    check(histograms.get("serve.ttft_s") is not None,
+          "serve.ttft_s histogram missing after the serving passes")
+
     # -- 3. the exposition renders ----------------------------------------
     dump = TELEMETRY.dump()
     check("serve_submitted" in dump and "_bucket{" in dump,
@@ -117,17 +135,40 @@ def main(argv=None) -> int:
             check(False, f"unparseable exposition line: {line!r}")
         check(bool(name), f"unparseable exposition line: {line!r}")
 
+    # -- 4. interference scenario with the recorder on --------------------
+    import bench
+
+    interference = bench.bench_serve_interference(
+        on_cpu=True, quick=True, model=serve_smoke.build_tiny_model(),
+    )
+    check(
+        interference["value"] > 0
+        and interference["monolithic_max_gap_ms"] > 0,
+        f"interference gap metric not finite: {interference}",
+    )
+    ipath = TELEMETRY.drain("interference")
+    check(ipath is not None, "interference drain produced no flight file")
+    if ipath is not None:
+        isummary = validate_flight_file(ipath)
+        check(isummary["unclosed"] == [],
+              f"interference spans left open: {isummary['unclosed_records']}")
+
     print(json.dumps({
         "flight_file": path,
         "records": summary["records"],
         "spans": summary["spans"],
         "request_outcomes": outcomes,
         "by_name": summary["by_name"],
+        "prefill_chunk_spans": n_chunk_spans,
+        "interference_max_gap_ms": interference["value"],
+        "interference_monolithic_max_gap_ms":
+            interference["monolithic_max_gap_ms"],
     }))
     if not ok:
         return 1
     print(f"telemetry smoke OK: {n_req} request span chains balanced, "
-          f"{summary['records']} records, /metrics renders", file=sys.stderr)
+          f"{summary['records']} records, /metrics renders, interference "
+          f"scenario traced", file=sys.stderr)
     return 0
 
 
