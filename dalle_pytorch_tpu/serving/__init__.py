@@ -1,8 +1,10 @@
 """Continuous-batching serving subsystem: request lifecycle, admission
-control, page-pool pressure handling. See engine.py for the architecture
-and docs/DESIGN.md for the failure model."""
+control, page-pool pressure handling, and the replicated front door.
+See engine.py for the single-replica architecture, router.py for the
+fleet coordinator, and docs/DESIGN.md for the failure models."""
 
 from .engine import Engine, EngineConfig, check_accounting
+from .router import ReplicaState, Router, RouterConfig
 from .scheduler import PagePool, Scheduler, TokenBudget, pages_for
 from .types import (
     Clock,
@@ -23,8 +25,11 @@ __all__ = [
     "Outcome",
     "PagePool",
     "RejectReason",
+    "ReplicaState",
     "Request",
     "RequestResult",
+    "Router",
+    "RouterConfig",
     "Scheduler",
     "TokenBudget",
     "check_accounting",

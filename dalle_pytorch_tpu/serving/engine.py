@@ -249,7 +249,9 @@ class Engine:
     """See module docstring. Host-side state machine + one device cache."""
 
     def __init__(self, dalle: DALLE, params, config: EngineConfig = EngineConfig(),
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None,
+                 metric_labels: Optional[dict] = None,
+                 fleet_occupancy=None):
         attn_types = tuple(dalle.attn_types or ("full",))
         if "mlp" in attn_types:
             raise EngineUnsupportedModel(
@@ -269,6 +271,19 @@ class Engine:
         self.params = params
         self.config = config
         self.clock = clock or Clock()
+        # label-bound metric registries: a router passes
+        # ``metric_labels={"replica": "<id>"}`` so every counter/gauge/
+        # histogram this engine writes becomes a per-replica series
+        # (``serve.occupancy{replica="1"}``); unlabeled engines get the
+        # process-wide registries back unchanged (child(None) is identity)
+        self.counters = counters.child(metric_labels)
+        self.gauges = gauges.child(metric_labels)
+        self.histograms = histograms.child(metric_labels)
+        # injectable occupancy for the watermark clamp: a router passes a
+        # FLEET-aggregate occupancy so degradation responds to pressure
+        # anywhere in the fleet (a dead sibling's load lands here), not
+        # just this engine's own pool
+        self._fleet_occupancy = fleet_occupancy
 
         self.page = kv_policy.page_size()
         self.T = dalle.text_len_internal
@@ -309,6 +324,11 @@ class Engine:
         )
         self.slots: List[Optional[_Slot]] = [None] * B
         self.results: Dict[str, RequestResult] = {}
+        # incremental outcome tally (updated wherever a result is stored):
+        # keeps stats() and the router's per-iteration verify_invariants
+        # probe O(outcomes), not O(results) — a long-lived engine's result
+        # dict grows without bound
+        self._outcome_counts: Dict[Outcome, int] = {o: 0 for o in Outcome}
         # open telemetry lifecycle spans: one "serve.request" per live
         # request, ended with its typed outcome (docs/DESIGN.md §9). The
         # dict stays empty when telemetry is disabled (begin returns None
@@ -347,7 +367,7 @@ class Engine:
         if request.request_id in self.results or request.request_id in self._live:
             raise ValueError(f"duplicate request_id {request.request_id!r}")
         self._submitted += 1
-        counters.inc("serve.submitted")
+        self.counters.inc("serve.submitted")
         now = self.clock.now()
         entry = Entry(request=request, submit_time=now, seq=self._seq)
         self._seq += 1
@@ -413,10 +433,7 @@ class Engine:
             "pool_used": self.pool.used,
             "pool_occupancy": self.pool.occupancy,
             "outcomes": {
-                o.value: sum(
-                    1 for r in self.results.values() if r.outcome is o
-                )
-                for o in Outcome
+                o.value: n for o, n in self._outcome_counts.items()
             },
         }
 
@@ -427,7 +444,7 @@ class Engine:
         running = [s for s in self.slots if s]
         if running and FAULTS.take("request_cancel"):
             victim = max(running, key=lambda s: s.admit_seq)
-            counters.inc("serve.fault_request_cancel")
+            self.counters.inc("serve.fault_request_cancel")
             self._cancel_requested.add(victim.entry.request_id)
         # cancellations: queued first (never prefilled -> no tokens) ...
         for rid in list(self._cancel_requested):
@@ -494,7 +511,7 @@ class Engine:
             entry.effective_max_new = eff_max_new
             entry.clamped = clamped
             if clamped:
-                counters.inc("serve.clamped")
+                self.counters.inc("serve.clamped")
             prompt_pages = pages_for(self.T, self.page)
             ok = self.pool.alloc(entry.request_id, prompt_pages)
             assert ok, "admission checked worst-case > prompt pages"
@@ -512,7 +529,7 @@ class Engine:
             except _PrefillFault:
                 self.pool.free_all(entry.request_id)
                 entry.prefill_attempts += 1
-                counters.inc("serve.prefill_retries")
+                self.counters.inc("serve.prefill_retries")
                 TELEMETRY.event(
                     "serve.prefill_retry", request_id=entry.request_id,
                     parent=req_span, attempt=entry.prefill_attempts,
@@ -537,7 +554,7 @@ class Engine:
             entry.generated = [int(tok0)]
             # queue wait = submit (or preemption requeue's ORIGINAL
             # submit) to this admission — what the client experienced
-            histograms.observe("serve.queue_wait_s", now - entry.submit_time)
+            self.histograms.observe("serve.queue_wait_s", now - entry.submit_time)
             TELEMETRY.event(
                 "serve.admit", request_id=entry.request_id, parent=req_span,
                 slot=idx, queue_wait_s=now - entry.submit_time,
@@ -549,7 +566,7 @@ class Engine:
             )
             self._admit_seq += 1
             self.slots[idx] = slot
-            counters.inc("serve.admitted")
+            self.counters.inc("serve.admitted")
             self._record_first_token(entry, now)
             if len(entry.generated) >= entry.effective_max_new:
                 self._complete(slot)
@@ -561,7 +578,7 @@ class Engine:
         now = self.clock.now()
         entry.admit_time = now
         req_span = self._req_spans.get(entry.request_id)
-        histograms.observe("serve.queue_wait_s", now - entry.submit_time)
+        self.histograms.observe("serve.queue_wait_s", now - entry.submit_time)
         TELEMETRY.event(
             "serve.admit", request_id=entry.request_id, parent=req_span,
             slot=idx, queue_wait_s=now - entry.submit_time,
@@ -582,18 +599,46 @@ class Engine:
             attempt=entry.prefill_attempts, chunked=True,
         )
         self.slots[idx] = slot
-        counters.inc("serve.admitted")
+        self.counters.inc("serve.admitted")
 
     def _degraded_budget(self, entry: Entry) -> tuple:
+        return self._clamped_budget(entry.request.max_new_tokens)
+
+    def _clamped_budget(self, want: int) -> tuple:
+        """(effective max_new_tokens, clamped?) under the watermark
+        degradation policy. Occupancy is this engine's own pool unless a
+        router injected a fleet aggregate (``fleet_occupancy``) — then
+        pressure anywhere in the fleet clamps admissions everywhere, which
+        is what makes degradation span replica boundaries."""
         cfg = self.config
-        want = entry.request.max_new_tokens
+        occ = (
+            self._fleet_occupancy()
+            if self._fleet_occupancy is not None
+            else self.pool.occupancy
+        )
         if (
             cfg.degraded_max_new_tokens is not None
-            and self.pool.occupancy > cfg.high_watermark
+            and occ > cfg.high_watermark
             and want > cfg.degraded_max_new_tokens
         ):
             return cfg.degraded_max_new_tokens, True
         return want, False
+
+    def can_admit(self, request: Request) -> bool:
+        """Router dispatch gate: True iff ``submit()`` now would be
+        admitted at the very next scheduling iteration — a free slot
+        exists, the internal queue is empty (preemption/retry requeues own
+        the head-of-line), and the worst-case page demand of the budget
+        the request would actually receive fits the currently free pages.
+        Keeping dispatch behind this gate is what keeps a replica's
+        internal queue empty, so a drain or failover never has to claw
+        queued work back out of an engine."""
+        if not any(s is None for s in self.slots):
+            return False
+        if len(self.sched):
+            return False
+        eff_max_new, _ = self._clamped_budget(request.max_new_tokens)
+        return self._worst_case_pages(eff_max_new) <= self.pool.free
 
     def _worst_case_pages(self, max_new: int) -> int:
         # positions WRITTEN to cache: the prompt (T) plus every generated
@@ -603,7 +648,7 @@ class Engine:
 
     def _prefill(self, entry: Entry):
         if FAULTS.take("prefill_fail"):
-            counters.inc("serve.fault_prefill_fail")
+            self.counters.inc("serve.fault_prefill_fail")
             raise _PrefillFault(entry.request_id)
         text = jnp.asarray(entry.request.prompt, jnp.int32)[None, :]
         internal = self.dalle.remap_text(text)
@@ -654,9 +699,9 @@ class Engine:
             while grant > 0 and self.slots[slot.index] is slot:
                 c = self._next_chunk(slot.filled)
                 if FAULTS.take("prefill_fail"):
-                    counters.inc("serve.fault_prefill_fail")
+                    self.counters.inc("serve.fault_prefill_fail")
                     entry.prefill_attempts += 1
-                    counters.inc("serve.prefill_retries")
+                    self.counters.inc("serve.prefill_retries")
                     TELEMETRY.event(
                         "serve.prefill_retry", request_id=entry.request_id,
                         parent=req_span, attempt=entry.prefill_attempts,
@@ -673,7 +718,7 @@ class Engine:
                         )
                     break  # retry next iteration, from this same chunk
                 worked = True
-                counters.inc("serve.prefill_chunks")
+                self.counters.inc("serve.prefill_chunks")
                 final = slot.filled + c >= self.T
                 chunk = jax.lax.dynamic_slice_in_dim(
                     slot.internal, slot.filled, c, axis=1
@@ -749,7 +794,7 @@ class Engine:
         if entry.ttft_s is not None:
             return
         entry.ttft_s = now - entry.submit_time
-        histograms.observe("serve.ttft_s", entry.ttft_s)
+        self.histograms.observe("serve.ttft_s", entry.ttft_s)
         TELEMETRY.event(
             "serve.first_token", request_id=entry.request_id,
             parent=self._req_spans.get(entry.request_id),
@@ -761,7 +806,7 @@ class Engine:
     def _decode_once(self) -> bool:
         cfg = self.config
         if FAULTS.take("decode_stall"):
-            counters.inc("serve.fault_decode_stall")
+            self.counters.inc("serve.fault_decode_stall")
             TELEMETRY.event(
                 "serve.decode_stall", penalty_s=cfg.stall_penalty_s
             )
@@ -805,7 +850,7 @@ class Engine:
             new_pending = None
             if dispatchable:
                 worked = True
-                counters.inc("serve.decode_steps")
+                self.counters.inc("serve.decode_steps")
                 new_pending = self._dispatch_decode(dispatchable, pending)
             if cfg.decode_lookahead:
                 prev, self._pending = pending, new_pending
@@ -883,7 +928,7 @@ class Engine:
         while True:
             blocked = FAULTS.take("page_exhaust")
             if blocked:
-                counters.inc("serve.fault_page_exhaust")
+                self.counters.inc("serve.fault_page_exhaust")
             if not blocked and self.pool.alloc(slot.entry.request_id, n):
                 return True
             victim = self._pick_victim()
@@ -909,7 +954,7 @@ class Engine:
         self._release_slot(slot)
         entry = slot.entry
         entry.preempt_count += 1
-        counters.inc("serve.preempted")
+        self.counters.inc("serve.preempted")
         TELEMETRY.event(
             "serve.evict", request_id=entry.request_id,
             parent=self._req_spans.get(entry.request_id),
@@ -968,20 +1013,20 @@ class Engine:
 
     def _complete(self, slot: _Slot) -> None:
         self._release_slot(slot)
-        counters.inc("serve.completed")
+        self.counters.inc("serve.completed")
         self._finish(
             slot.entry, Outcome.COMPLETED,
             tokens=np.asarray(slot.entry.generated, np.int32),
         )
 
     def _reject(self, entry: Entry, reason: RejectReason) -> RequestResult:
-        counters.inc("serve.rejected")
-        counters.inc(f"serve.rejected.{reason.value}")
+        self.counters.inc("serve.rejected")
+        self.counters.inc(f"serve.rejected.{reason.value}")
         TELEMETRY.end(
             self._req_spans.pop(entry.request_id, None),
             outcome=Outcome.REJECTED.value, reject_reason=reason.value,
         )
-        histograms.observe("serve.request_latency_s", 0.0)
+        self.histograms.observe("serve.request_latency_s", 0.0)
         result = RequestResult(
             request_id=entry.request_id,
             outcome=Outcome.REJECTED,
@@ -989,6 +1034,7 @@ class Engine:
             total_latency_s=0.0,
         )
         self.results[entry.request_id] = result
+        self._outcome_counts[Outcome.REJECTED] += 1
         return result
 
     def _finish(self, entry: Entry, outcome: Outcome,
@@ -996,7 +1042,7 @@ class Engine:
         now = self.clock.now()
         self._live.discard(entry.request_id)
         if outcome is not Outcome.COMPLETED:
-            counters.inc(f"serve.{outcome.value}")
+            self.counters.inc(f"serve.{outcome.value}")
         # the lifecycle span ends HERE, in its typed outcome — the flight
         # recorder's per-request chain is submit(B) .. outcome(E)
         TELEMETRY.end(
@@ -1006,11 +1052,12 @@ class Engine:
             preempt_count=entry.preempt_count,
             detail=detail,
         )
-        histograms.observe("serve.request_latency_s", now - entry.submit_time)
+        self.histograms.observe("serve.request_latency_s", now - entry.submit_time)
         if outcome is Outcome.COMPLETED:
-            histograms.observe(
+            self.histograms.observe(
                 "serve.completed_latency_s", now - entry.submit_time
             )
+        self._outcome_counts[outcome] += 1
         self.results[entry.request_id] = RequestResult(
             request_id=entry.request_id,
             outcome=outcome,
@@ -1029,17 +1076,69 @@ class Engine:
             detail=detail,
         )
 
+    def verify_invariants(self, idle: bool = False) -> None:
+        """Assert the typed-outcome accounting invariant, raising
+        ``AssertionError`` on violation. Public because it is a RELEASE
+        and HEALTH surface, not just a test helper: the smoke gates
+        (tools/serve_smoke.py, tools/telemetry_smoke.py) assert it after
+        every pass, and the replica router (serving/router.py) probes it
+        every scheduling iteration — an engine that breaks its own
+        accounting is declared DEAD and failed over, because a lost or
+        duplicated request is exactly the corruption the fleet exists to
+        prevent.
+
+        Always checked (valid mid-flight):
+          * every submitted request is live XOR has exactly one result;
+          * live requests are exactly the queued + running sets;
+          * every page holder is a running request;
+          * outcome counts sum to the result count.
+        With ``idle=True`` (after ``run()``): additionally nothing queued
+        or running, no live in-flight decode step, and the pool fully
+        drained.
+
+        Cost: O(live requests + slots), independent of how many results a
+        long-lived engine has accumulated (outcome tallies are
+        incremental) — cheap enough for the router to probe every
+        scheduling iteration."""
+        running_ids = {s.entry.request_id for s in self.slots if s}
+        queued_ids = self.sched.ids()
+        both = [rid for rid in self._live if rid in self.results]
+        assert not both, f"request both live and finished: {sorted(both)}"
+        assert len(self.results) + len(self._live) == self._submitted, (
+            f"{self._submitted} submitted but {len(self.results)} results "
+            f"+ {len(self._live)} live"
+        )
+        assert self._live == queued_ids | running_ids, (
+            f"live set {sorted(self._live)} != queued {sorted(queued_ids)} "
+            f"| running {sorted(running_ids)}"
+        )
+        assert self.pool.holders() <= running_ids, (
+            "page leak: pages held by non-running requests "
+            f"{sorted(self.pool.holders() - running_ids)}"
+        )
+        outcomes = self.stats()["outcomes"]
+        assert sum(outcomes.values()) == len(self.results), outcomes
+        if not idle:
+            return
+        assert not running_ids and not queued_ids, "engine not idle"
+        assert self._pending is None or not any(
+            self.slots[s.index] is s for s in self._pending[1]
+        ), "engine idle with a live in-flight decode step"
+        assert self.pool.used == 0, (
+            f"page leak: {self.pool.used} pages still held"
+        )
+
     def _publish_gauges(self) -> None:
-        gauges.set("serve.pool_occupancy", self.pool.occupancy)
-        gauges.set(
+        self.gauges.set("serve.pool_occupancy", self.pool.occupancy)
+        self.gauges.set(
             "serve.running",
             sum(bool(s) and s.phase == _DECODE for s in self.slots),
         )
-        gauges.set(
+        self.gauges.set(
             "serve.prefilling",
             sum(bool(s) and s.phase == _PREFILL for s in self.slots),
         )
-        gauges.set("serve.queued", len(self.sched))
+        self.gauges.set("serve.queued", len(self.sched))
 
 
 class _PrefillFault(RuntimeError):
@@ -1047,20 +1146,7 @@ class _PrefillFault(RuntimeError):
 
 
 def check_accounting(engine: Engine) -> None:
-    """Assert the acceptance invariant: every submitted request has exactly
-    one terminal result and the pool is fully drained when idle. Tests and
-    the smoke gate call this after ``run()``."""
-    assert not any(engine.slots) and not len(engine.sched), (
-        "engine not idle"
-    )
-    assert engine._pending is None or not any(
-        engine.slots[s.index] is s for s in engine._pending[1]
-    ), "engine idle with a live in-flight decode step"
-    assert len(engine.results) == engine._submitted, (
-        f"{engine._submitted} submitted but {len(engine.results)} results"
-    )
-    assert engine.pool.used == 0, (
-        f"page leak: {engine.pool.used} pages still held"
-    )
-    outcomes = engine.stats()["outcomes"]
-    assert sum(outcomes.values()) == engine._submitted, outcomes
+    """Back-compat alias for ``Engine.verify_invariants(idle=True)`` —
+    the original test-helper name, kept because tests and bench call it
+    pervasively. New code should call the method."""
+    engine.verify_invariants(idle=True)

@@ -1,0 +1,671 @@
+"""Replicated serving front door: N engines behind one submit()/run().
+
+The engine (engine.py) is a single point of failure — one stalled prefill
+or crashed replica takes the whole serving path down. This module is the
+host-side coordinator level of the FastUSP multi-level-collaboration
+shape (PAPERS.md): a ``Router`` owns N in-process ``Engine`` replicas
+(same model/params, per-replica metric labels, ONE shared clock) and
+presents the engine's own ``submit()``/``run()`` API, with robustness —
+failure detection, retry/backoff, graceful degradation — as the headline.
+Sharding each replica with pjit partition rules is the follow-on
+(ROADMAP item 2); here every replica is a full engine and the router is
+pure host-side policy, unit-testable on CPU like the scheduler.
+
+**Health state machine.** Each replica is HEALTHY → DEGRADED → DRAINING
+→ DEAD, driven by two signals the engines already emit:
+
+* *step-progress heartbeats*: per-replica labeled counters
+  (``serve.decode_steps{replica=i}`` + ``serve.prefill_chunks`` +
+  ``serve.admitted`` + harvested results). A replica with live work whose
+  progress value does not move for ``stall_timeout_s`` on the shared
+  clock is declared DEAD — the host-side analog of a hung device
+  dispatch (injectable: ``replica_stall``).
+* *the typed-outcome accounting invariant*: the router probes
+  ``Engine.verify_invariants()`` every scheduling iteration; an engine
+  that lost or duplicated a request is corrupt and is declared DEAD
+  immediately — exactly the corruption the fleet exists to contain.
+
+**Circuit breaker.** ``breaker_threshold`` consecutive prefill failures
+(observed via the ``serve.prefill_retries{replica=i}`` counter delta,
+reset by any successful admission) open the breaker: the replica is
+DEGRADED — no new admissions, in-flight work continues — and readmitted
+(→ HEALTHY) after a ``RetryPolicy`` exponential-backoff delay
+(``breaker_backoff``; attempt i waits ``min(max_delay, base * 2**i)``,
+deterministic — the policy's jitter field is for cross-process
+thundering herds and is deliberately ignored so chaos drills replay
+exactly). Re-trips back off further; ``breaker_backoff.attempts``
+consecutive trips without an intervening success escalate to DEAD.
+The backoff is the admission-livelock guard: a flapping health signal
+(injectable: ``health_flap``) makes the replica *progressively quieter*
+instead of bouncing admissions forever.
+
+**Routing.** Least-loaded: a queued request is dispatched to the HEALTHY
+replica with the most free pages whose ``Engine.can_admit`` gate passes
+(free slot, empty internal queue, worst-case demand fits free pages).
+Dispatch-behind-the-gate keeps every replica's internal queue empty, so
+the router never has to claw queued work back out of an engine — a
+drain or crash only ever deals with in-flight slots. Head-of-line in
+priority order, like the engine's own scheduler and for the same
+anti-starvation reason.
+
+**Failover.** When a replica dies (crash, stall timeout, invariant
+violation, breaker escalation — injectable: ``replica_crash``), its
+engine is abandoned the way a dead host's would be: unharvested results
+are lost, and every in-flight request is requeued to the router and
+re-dispatched to a sibling. Because sampling is keyed by per-request
+``(seed, position)`` fold-ins and decode math is row-independent at
+fixed batch width, the replay on the new replica is **bit-identical**
+to an uninterrupted run — PR 3's preempt-and-requeue guarantee extended
+across replica boundaries. Partial tokens from the dead replica are
+discarded (replay regenerates them); ``max_failovers`` is the backstop
+that turns a replica-death loop into the typed ``preempt_cap`` outcome.
+A request's ``deadline`` stays an absolute instant on the ONE shared
+clock injected into every replica, so a deadline that expires during
+failover means the same moment on the new replica as on the old.
+
+**Global admission & load shedding.** The router's own bounded queue
+rejects typed ``queue_full`` (with a ``router.shed`` event); demand that
+can never fit a replica rejects ``demand_exceeds_pool``; a fleet with
+no live replica rejects (and flushes its queue as) ``no_replica``.
+Watermark degradation spans the fleet: every engine's clamp policy is
+fed the *aggregate* occupancy over live replicas (``fleet_occupancy``
+hook), so pressure anywhere — including capacity lost to a dead
+sibling — degrades admissions everywhere, visibly
+(``clamped_max_new_tokens`` in the response, as ever).
+
+Observability: per-replica ``serve.*{replica=i}`` series (labeled
+registries, utils/metrics.py), router counters/gauges under
+``router.*``, a ``router.request`` lifecycle span per request ended with
+its typed outcome, events ``router.failover`` / ``router.drain`` /
+``router.shed`` / ``router.breaker_open`` / ``router.readmit``, and the
+``router.failover_latency_s`` histogram (replica death → failover
+dispatch). A dead replica's unclosed ``serve.request`` spans in a flight
+recording are not corruption — they are the postmortem of what died
+in flight, same contract as §9's crash captures.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional
+
+from ..utils.faults import FAULTS
+from ..utils.metrics import counters, gauges, histograms
+from ..utils.resilience import RetryPolicy
+from ..utils.telemetry import TELEMETRY
+from .engine import Engine, EngineConfig
+from .types import Clock, Outcome, RejectReason, Request, RequestResult
+
+
+class ReplicaState(str, Enum):
+    """Health of one replica. str-valued for JSON-able stats, like
+    ``Outcome``."""
+
+    HEALTHY = "healthy"      # admitting and serving
+    DEGRADED = "degraded"    # breaker open: no new admissions, serving
+    DRAINING = "draining"    # operator drain: no new admissions, finishing
+    DEAD = "dead"            # crashed / stalled / corrupt / retired
+
+
+_STATE_CODE = {
+    ReplicaState.HEALTHY: 0,
+    ReplicaState.DEGRADED: 1,
+    ReplicaState.DRAINING: 2,
+    ReplicaState.DEAD: 3,
+}
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Fleet-level knobs; per-replica behavior stays in ``EngineConfig``."""
+
+    n_replicas: int = 2
+    # router-level bounded admission queue (global, spans the fleet)
+    queue_limit: int = 256
+    # circuit breaker: consecutive prefill failures before DEGRADED
+    breaker_threshold: int = 3
+    # readmission schedule; .attempts consecutive trips escalate to DEAD.
+    # retry_on is unused (nothing is raised); jitter is ignored for
+    # deterministic drills — see module docstring.
+    breaker_backoff: RetryPolicy = RetryPolicy(
+        attempts=5, base_delay=1.0, max_delay=60.0, jitter=0.0,
+        retry_on=(),
+    )
+    # heartbeat: busy with no step progress for this long (shared clock)
+    # => the replica is declared DEAD and its work failed over
+    stall_timeout_s: float = 30.0
+    # replica deaths one request survives before the typed preempt_cap
+    max_failovers: int = 3
+
+
+@dataclass
+class _RouterEntry:
+    """A request's fleet-level scheduling state (the router analog of
+    ``scheduler.Entry``). Lives from router submit to router-terminal
+    result; rides the router queue and then exactly one replica."""
+
+    request: Request
+    seq: int
+    submit_time: float
+    failovers: int = 0
+    # set when a replica death requeued this entry; cleared (and observed
+    # into router.failover_latency_s) at the failover dispatch
+    crash_t0: Optional[float] = None
+
+    @property
+    def request_id(self) -> str:
+        return self.request.request_id
+
+
+class _Replica:
+    """One engine plus its health bookkeeping."""
+
+    def __init__(self, rid: int, engine: Engine, now: float):
+        self.id = rid
+        self.engine = engine
+        self.state = ReplicaState.HEALTHY
+        self.inflight: Dict[str, _RouterEntry] = {}
+        self.death_reason: Optional[str] = None
+        # heartbeat
+        self.last_progress_t = now
+        self.skip_steps = 0          # injected stall: steps to skip
+        # health baselines snapshot the CURRENT process-global labeled
+        # counters — a second Router in the same process (smoke/bench run
+        # clean + chaos passes back to back) must not inherit the previous
+        # fleet's retries as a spurious first-check delta that pops its
+        # breaker before any failure happened
+        self.last_progress_val = self.progress_value()
+        self.seen_retries = counters.get(
+            "serve.prefill_retries", labels=self.labels
+        )
+        self.seen_admits = counters.get("serve.admitted", labels=self.labels)
+        # circuit breaker
+        self.breaker_consec = 0      # consecutive prefill failures
+        self.breaker_trips = 0       # consecutive openings w/o a success
+        self.retry_at: Optional[float] = None
+
+    @property
+    def labels(self) -> dict:
+        return {"replica": str(self.id)}
+
+    def progress_value(self) -> int:
+        """Monotone per-replica work tally — the heartbeat signal. Reads
+        the same labeled counters an operator dashboard does."""
+        c = counters
+        return (
+            c.get("serve.decode_steps", labels=self.labels)
+            + c.get("serve.prefill_chunks", labels=self.labels)
+            + c.get("serve.admitted", labels=self.labels)
+            + len(self.engine.results)
+        )
+
+
+class Router:
+    """See module docstring. Host-side fleet policy + N engines."""
+
+    def __init__(self, dalle, params, config: RouterConfig = RouterConfig(),
+                 engine_config: EngineConfig = EngineConfig(),
+                 clock: Optional[Clock] = None):
+        assert config.n_replicas >= 1, config.n_replicas
+        self.config = config
+        self.clock = clock or Clock()
+        now = self.clock.now()
+        self._replicas: List[_Replica] = [
+            _Replica(
+                i,
+                Engine(
+                    dalle, params, engine_config, clock=self.clock,
+                    metric_labels={"replica": str(i)},
+                    fleet_occupancy=self.fleet_occupancy,
+                ),
+                now,
+            )
+            for i in range(config.n_replicas)
+        ]
+        self._queue: List[_RouterEntry] = []
+        self.results: Dict[str, RequestResult] = {}
+        self._outcome_counts: Dict[Outcome, int] = {o: 0 for o in Outcome}
+        self._spans: Dict[str, Optional[int]] = {}
+        self._live: set = set()
+        self._seq = 0
+        self._submitted = 0
+
+    # ------------------------------------------------------------ public
+
+    def submit(self, request: Request) -> Optional[RequestResult]:
+        """Queue a request with the fleet; same contract as
+        ``Engine.submit`` — an immediate typed reject returns the result,
+        otherwise None and the result lands in ``self.results``."""
+        proto = self._replicas[0].engine
+        if not (0 < request.max_new_tokens <= proto.dalle.image_seq_len):
+            raise ValueError(
+                f"max_new_tokens must be in [1, {proto.dalle.image_seq_len}], "
+                f"got {request.max_new_tokens}"
+            )
+        if request.request_id in self.results or request.request_id in self._live:
+            raise ValueError(f"duplicate request_id {request.request_id!r}")
+        self._submitted += 1
+        counters.inc("router.submitted")
+        now = self.clock.now()
+        self._spans[request.request_id] = TELEMETRY.begin(
+            "router.request", request_id=request.request_id,
+            priority=request.priority,
+        )
+        entry = _RouterEntry(request=request, seq=self._seq, submit_time=now)
+        self._seq += 1
+        live = [r for r in self._replicas if r.state is not ReplicaState.DEAD]
+        if not live:
+            return self._reject(entry, RejectReason.NO_REPLICA)
+        # worst-case demand vs the LARGEST live pool: a request no replica
+        # could ever hold is dead on arrival, fleet-wide
+        worst = proto._worst_case_pages(request.max_new_tokens)
+        if worst > max(r.engine.pool.total for r in live):
+            return self._reject(entry, RejectReason.DEMAND_EXCEEDS_POOL)
+        if len(self._queue) >= self.config.queue_limit:
+            TELEMETRY.event(
+                "router.shed", request_id=request.request_id,
+                queued=len(self._queue),
+            )
+            counters.inc("router.shed")
+            return self._reject(entry, RejectReason.QUEUE_FULL)
+        self._queue.append(entry)
+        self._live.add(request.request_id)
+        return None
+
+    def cancel(self, request_id: str) -> None:
+        """Cancel wherever the request currently lives: still queued at
+        the router => terminal here next sweep; in flight on a replica =>
+        forwarded to that engine (takes effect between its iterations)."""
+        for entry in self._queue:
+            if entry.request_id == request_id:
+                self._queue.remove(entry)
+                self._finish(entry, RequestResult(
+                    request_id=request_id, outcome=Outcome.CANCELLED,
+                    total_latency_s=self.clock.now() - entry.submit_time,
+                ))
+                return
+        for r in self._replicas:
+            if r.state is not ReplicaState.DEAD and request_id in r.inflight:
+                r.engine.cancel(request_id)
+                return
+
+    def drain(self, replica_id: int) -> None:
+        """Graceful drain: stop admitting to the replica, let in-flight
+        work finish, then retire it. Requests still queued at the router
+        simply route to siblings (the ``can_admit`` dispatch gate means a
+        replica's internal queue is already empty)."""
+        r = self._replicas[replica_id]
+        if r.state in (ReplicaState.DEAD, ReplicaState.DRAINING):
+            return
+        r.state = ReplicaState.DRAINING
+        counters.inc("router.drains")
+        TELEMETRY.event(
+            "router.drain", replica=r.id, inflight=len(r.inflight),
+        )
+
+    def step(self) -> bool:
+        """One fleet scheduling iteration: fault injections -> router
+        deadline sweep -> drive + harvest every live replica -> health
+        checks -> retire finished drains -> dispatch -> all-dead flush.
+        Returns False when the fleet is fully idle."""
+        self._inject_faults()
+        self._sweep_queue_deadlines()
+        stepped = 0
+        for r in self._replicas:
+            if r.state is ReplicaState.DEAD:
+                continue
+            if r.skip_steps > 0:
+                r.skip_steps -= 1   # injected stall: the engine hangs
+            else:
+                r.engine.step()
+                stepped += 1
+            self._harvest(r)
+        for r in self._replicas:
+            if r.state is not ReplicaState.DEAD:
+                self._health_check(r)
+        for r in self._replicas:
+            if (
+                r.state is ReplicaState.DRAINING
+                and not r.inflight
+                and not any(r.engine.slots)
+                and not len(r.engine.sched)
+            ):
+                r.state = ReplicaState.DEAD
+                r.death_reason = "drained"
+                counters.inc("router.drained")
+                TELEMETRY.event("router.drained", replica=r.id)
+        self._dispatch()
+        if all(r.state is ReplicaState.DEAD for r in self._replicas):
+            self._flush_no_replica()
+        if stepped == 0:
+            # every replica dead/stalled: time must still advance (engine
+            # steps normally tick the shared clock) or deadline sweeps and
+            # the stall heartbeat itself would freeze with it
+            self.clock.tick()
+        self._publish_gauges()
+        return bool(self._queue) or any(r.inflight for r in self._replicas)
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[str, RequestResult]:
+        """Drive until idle; ``max_steps`` is the same loud safety valve
+        as ``Engine.run``."""
+        steps = 0
+        while self.step():
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"router made no terminal progress in {max_steps} steps: "
+                    f"{len(self._queue)} queued, "
+                    f"{sum(len(r.inflight) for r in self._replicas)} in flight"
+                )
+        return self.results
+
+    def fleet_occupancy(self) -> float:
+        """Aggregate page occupancy over LIVE replicas — capacity lost to
+        a dead sibling raises the remaining fleet's pressure, which is
+        what lets the watermark clamp degrade admissions fleet-wide."""
+        live = [r for r in self._replicas if r.state is not ReplicaState.DEAD]
+        total = sum(r.engine.pool.total for r in live)
+        if total == 0:
+            return 1.0
+        return sum(r.engine.pool.used for r in live) / total
+
+    def replica_states(self) -> Dict[int, str]:
+        return {r.id: r.state.value for r in self._replicas}
+
+    def stats(self) -> dict:
+        return {
+            "submitted": self._submitted,
+            "queued": len(self._queue),
+            "fleet_occupancy": self.fleet_occupancy(),
+            "outcomes": {
+                o.value: n for o, n in self._outcome_counts.items()
+            },
+            "replicas": {
+                r.id: {
+                    "state": r.state.value,
+                    "death_reason": r.death_reason,
+                    "inflight": len(r.inflight),
+                    "pool_occupancy": r.engine.pool.occupancy,
+                    "breaker_trips": r.breaker_trips,
+                }
+                for r in self._replicas
+            },
+        }
+
+    def verify_invariants(self) -> None:
+        """Fleet-level accounting: every submitted request is live XOR has
+        exactly one router result (none lost, none duplicated), the live
+        set is exactly queue + in-flight, every live engine's own
+        invariants hold, and every live engine's live requests are tracked
+        by the router."""
+        inflight_ids = set()
+        for r in self._replicas:
+            assert not (inflight_ids & set(r.inflight)), "request on two replicas"
+            inflight_ids |= set(r.inflight)
+        queued_ids = {e.request_id for e in self._queue}
+        both = [rid for rid in self._live if rid in self.results]
+        assert not both, f"request both live and finished: {sorted(both)}"
+        assert len(self.results) + len(self._live) == self._submitted, (
+            f"{self._submitted} submitted but {len(self.results)} results "
+            f"+ {len(self._live)} live"
+        )
+        assert self._live == queued_ids | inflight_ids, (
+            f"live {sorted(self._live)} != queued {sorted(queued_ids)} | "
+            f"inflight {sorted(inflight_ids)}"
+        )
+        outcomes = self.stats()["outcomes"]
+        assert sum(outcomes.values()) == len(self.results), outcomes
+        for r in self._replicas:
+            if r.state is not ReplicaState.DEAD:
+                r.engine.verify_invariants()
+                assert r.engine._live <= set(r.inflight), (
+                    f"replica {r.id} serving untracked requests "
+                    f"{sorted(r.engine._live - set(r.inflight))}"
+                )
+
+    # ---------------------------------------------------------- injections
+
+    def _inject_faults(self) -> None:
+        # eligibility is checked BEFORE take(): an armed fault with no
+        # eligible victim stays armed for the next iteration instead of
+        # being silently swallowed
+        victim = self._busiest_live()
+        if victim is not None and FAULTS.take("replica_crash"):
+            counters.inc("router.fault_replica_crash")
+            self._kill(victim, "crash")
+            victim = self._busiest_live()
+        if victim is not None and FAULTS.take("replica_stall"):
+            counters.inc("router.fault_replica_stall")
+            victim.skip_steps += 1
+        healthy = [
+            r for r in self._replicas if r.state is ReplicaState.HEALTHY
+        ]
+        if healthy and FAULTS.take("health_flap"):
+            counters.inc("router.fault_health_flap")
+            self._open_breaker(healthy[0], "health_flap")
+
+    def _busiest_live(self) -> Optional[_Replica]:
+        live = [r for r in self._replicas if r.state is not ReplicaState.DEAD]
+        if not live:
+            return None
+        return max(live, key=lambda r: (len(r.inflight), -r.id))
+
+    # ------------------------------------------------------------- health
+
+    def _health_check(self, r: _Replica) -> None:
+        # accounting invariant: a corrupt engine is dead NOW — routing
+        # more work into it can only lose or duplicate requests
+        try:
+            r.engine.verify_invariants()
+        except AssertionError as e:
+            TELEMETRY.event(
+                "router.invariant_violation", replica=r.id, detail=str(e)[:200]
+            )
+            self._kill(r, "invariant_violation")
+            return
+        now = self.clock.now()
+        # circuit breaker: consecutive prefill failures via counter deltas
+        retries = counters.get("serve.prefill_retries", labels=r.labels)
+        admits = counters.get("serve.admitted", labels=r.labels)
+        d_retry = retries - r.seen_retries
+        d_admit = admits - r.seen_admits
+        r.seen_retries, r.seen_admits = retries, admits
+        if d_admit > 0:
+            r.breaker_consec = 0
+            r.breaker_trips = 0  # a success closes the escalation ladder
+        r.breaker_consec += d_retry
+        if (
+            r.state is ReplicaState.HEALTHY
+            and r.breaker_consec >= self.config.breaker_threshold
+        ):
+            self._open_breaker(r, "prefill_failures")
+        # breaker readmission after backoff
+        if (
+            r.state is ReplicaState.DEGRADED
+            and r.retry_at is not None
+            and now >= r.retry_at
+        ):
+            r.state = ReplicaState.HEALTHY
+            r.retry_at = None
+            counters.inc("router.readmits")
+            TELEMETRY.event(
+                "router.readmit", replica=r.id, trips=r.breaker_trips
+            )
+        # step-progress heartbeat
+        progress = r.progress_value()
+        if progress != r.last_progress_val or not r.inflight:
+            r.last_progress_val = progress
+            r.last_progress_t = now
+        elif now - r.last_progress_t > self.config.stall_timeout_s:
+            self._kill(r, "stall_timeout")
+
+    def _open_breaker(self, r: _Replica, reason: str) -> None:
+        policy = self.config.breaker_backoff
+        r.breaker_trips += 1
+        r.breaker_consec = 0
+        if r.breaker_trips > max(1, policy.attempts):
+            self._kill(r, "breaker_exhausted")
+            return
+        delay = min(
+            policy.max_delay, policy.base_delay * (2 ** (r.breaker_trips - 1))
+        )
+        r.retry_at = self.clock.now() + delay
+        r.state = ReplicaState.DEGRADED
+        counters.inc("router.breaker_opens")
+        TELEMETRY.event(
+            "router.breaker_open", replica=r.id, reason=reason,
+            trips=r.breaker_trips, retry_in_s=delay,
+        )
+
+    # ----------------------------------------------------------- failover
+
+    def _kill(self, r: _Replica, reason: str) -> None:
+        """Declare a replica dead and fail its in-flight work over. The
+        engine is abandoned like a dead host: unharvested results are
+        lost; requeued requests replay from scratch on a sibling —
+        bit-identically, by the (seed, position) sampling contract."""
+        r.state = ReplicaState.DEAD
+        r.death_reason = reason
+        counters.inc("router.replica_deaths")
+        now = self.clock.now()
+        TELEMETRY.event(
+            "router.failover", replica=r.id, reason=reason,
+            inflight=len(r.inflight),
+        )
+        for rid, entry in sorted(r.inflight.items(), key=lambda kv: kv[1].seq):
+            entry.failovers += 1
+            entry.crash_t0 = now
+            if entry.failovers > self.config.max_failovers:
+                self._finish(entry, RequestResult(
+                    request_id=rid, outcome=Outcome.PREEMPT_CAP,
+                    preempt_count=entry.failovers,
+                    total_latency_s=now - entry.submit_time,
+                    detail=f"lost {entry.failovers} replicas "
+                           f"(max_failovers {self.config.max_failovers})",
+                ))
+            else:
+                self._queue.append(entry)
+        r.inflight.clear()
+
+    def _flush_no_replica(self) -> None:
+        """Fleet fully dead: every queued request ends typed rather than
+        hanging — the none-lost half of the accounting invariant."""
+        for entry in list(self._queue):
+            self._queue.remove(entry)
+            counters.inc("router.no_replica")
+            self._finish(entry, RequestResult(
+                request_id=entry.request_id, outcome=Outcome.REJECTED,
+                reject_reason=RejectReason.NO_REPLICA,
+                total_latency_s=self.clock.now() - entry.submit_time,
+                detail="fleet has no live replica",
+            ))
+
+    # ----------------------------------------------------------- dispatch
+
+    def _sweep_queue_deadlines(self) -> None:
+        now = self.clock.now()
+        for entry in list(self._queue):
+            d = entry.request.deadline
+            if d is not None and now > d:
+                self._queue.remove(entry)
+                self._finish(entry, RequestResult(
+                    request_id=entry.request_id,
+                    outcome=Outcome.DEADLINE_EXCEEDED,
+                    total_latency_s=now - entry.submit_time,
+                    detail="deadline passed in router queue",
+                ))
+
+    def _dispatch(self) -> None:
+        """Route queued work: head-of-line in (priority, FIFO) order to
+        the least-loaded admittable HEALTHY replica. Strict head-of-line
+        (nothing behind a stuck head goes first) for the scheduler's
+        anti-starvation reason."""
+        # one sort per pass: nothing is appended to the queue while this
+        # loop runs (submits and failover requeues happen between steps)
+        self._queue.sort(key=lambda e: (-e.request.priority, e.seq))
+        while self._queue:
+            entry = self._queue[0]
+            candidates = [
+                r for r in self._replicas
+                if r.state is ReplicaState.HEALTHY
+                and r.engine.can_admit(entry.request)
+            ]
+            if not candidates:
+                return
+            r = max(candidates, key=lambda c: (c.engine.pool.free, -c.id))
+            self._queue.pop(0)
+            now = self.clock.now()
+            if entry.crash_t0 is not None:
+                latency = now - entry.crash_t0
+                histograms.observe("router.failover_latency_s", latency)
+                counters.inc("router.failovers")
+                TELEMETRY.event(
+                    "router.failover_dispatch",
+                    request_id=entry.request_id, replica=r.id,
+                    latency_s=latency, failovers=entry.failovers,
+                )
+                entry.crash_t0 = None
+            rejected = r.engine.submit(entry.request)
+            if rejected is not None:
+                # can_admit said yes but the engine refused — surface the
+                # engine's typed reason rather than hiding a router bug
+                self._finish(entry, rejected)
+                continue
+            r.inflight[entry.request_id] = entry
+
+    # ------------------------------------------------------------ harvest
+
+    def _harvest(self, r: _Replica) -> None:
+        for rid in list(r.inflight):
+            res = r.engine.results.get(rid)
+            if res is None:
+                continue
+            entry = r.inflight.pop(rid)
+            if entry.failovers:
+                res.detail = (
+                    f"{res.detail} (failovers={entry.failovers})".strip()
+                )
+            self._finish(entry, res)
+
+    # ----------------------------------------------------------- plumbing
+
+    def _reject(self, entry: _RouterEntry, reason: RejectReason) -> RequestResult:
+        result = RequestResult(
+            request_id=entry.request_id,
+            outcome=Outcome.REJECTED,
+            reject_reason=reason,
+            total_latency_s=0.0,
+        )
+        self._finish(entry, result)
+        return result
+
+    def _finish(self, entry: _RouterEntry, result: RequestResult) -> None:
+        assert entry.request_id not in self.results, (
+            f"duplicate terminal result for {entry.request_id!r}"
+        )
+        self._live.discard(entry.request_id)
+        self.results[entry.request_id] = result
+        self._outcome_counts[result.outcome] += 1
+        counters.inc(f"router.{result.outcome.value}")
+        TELEMETRY.end(
+            self._spans.pop(entry.request_id, None),
+            outcome=result.outcome.value,
+            reject_reason=(
+                None if result.reject_reason is None
+                else result.reject_reason.value
+            ),
+            failovers=entry.failovers,
+        )
+
+    def _publish_gauges(self) -> None:
+        gauges.set("router.queued", len(self._queue))
+        gauges.set("router.fleet_occupancy", self.fleet_occupancy())
+        gauges.set("router.replicas_live", sum(
+            r.state is not ReplicaState.DEAD for r in self._replicas
+        ))
+        for r in self._replicas:
+            gauges.set(
+                "router.replica_state_code", _STATE_CODE[r.state],
+                labels=r.labels,
+            )

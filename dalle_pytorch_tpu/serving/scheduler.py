@@ -113,6 +113,12 @@ class PagePool:
     def held(self, request_id: str) -> int:
         return self._held.get(request_id, 0)
 
+    def holders(self) -> set:
+        """Ids currently holding pages — the invariant checker
+        (``Engine.verify_invariants``) asserts every holder is a running
+        slot's request."""
+        return set(self._held)
+
     def alloc(self, request_id: str, n: int) -> bool:
         assert n >= 0, n
         if n > self.free:
@@ -215,6 +221,10 @@ class Scheduler:
 
     def peek(self) -> Optional[Entry]:
         return self._heap[0][2] if self._heap else None
+
+    def ids(self) -> set:
+        """Request ids of every queued entry (invariant checks)."""
+        return {e.request_id for (_, _, e) in self._heap}
 
     def pop(self) -> Entry:
         entry = heapq.heappop(self._heap)[2]

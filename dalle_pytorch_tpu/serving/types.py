@@ -42,6 +42,10 @@ class Outcome(str, Enum):
 class RejectReason(str, Enum):
     DEMAND_EXCEEDS_POOL = "demand_exceeds_pool"  # can never fit, even idle
     QUEUE_FULL = "queue_full"                    # bounded admission queue
+    # router-level (serving/router.py): the fleet has no live replica left
+    # to run anything — every replica is DEAD/retired. Queued requests are
+    # flushed with this reason rather than hanging forever.
+    NO_REPLICA = "no_replica"
 
 
 @dataclass(frozen=True)

@@ -38,6 +38,19 @@ Sites in use:
                  write raises ``OSError`` N times — pins that telemetry
                  I/O failures stay counted and contained (fail open),
                  never propagating into the train/serve loop
+``replica_crash`` ``serving.router``: the busiest live replica dies
+                 abruptly — its engine is abandoned (unharvested results
+                 lost, like a dead host's), its in-flight requests are
+                 requeued to siblings, where (seed, position) sampling
+                 replays them bit-identically
+``replica_stall`` ``serving.router``: the busiest live replica skips one
+                 scheduling step per armed count (a hung device
+                 dispatch); sustained past ``stall_timeout_s`` the
+                 heartbeat declares it dead and fails its work over
+``health_flap``  ``serving.router``: the health check spuriously trips
+                 the circuit breaker on a healthy replica (flapping
+                 probe) — pins that breaker backoff prevents admission
+                 livelock under repeated flaps
 ===============  =============================================================
 
 Injection must be impossible to leave on by accident: the registry is
@@ -64,6 +77,7 @@ KNOWN_SITES = frozenset({
     "download", "shard_open", "shard_read", "ckpt_corrupt", "nan_at_step",
     "page_exhaust", "prefill_fail", "decode_stall", "request_cancel",
     "telemetry_sink_fail",
+    "replica_crash", "replica_stall", "health_flap",
 })
 
 

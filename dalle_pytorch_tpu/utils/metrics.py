@@ -17,6 +17,69 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+# ------------------------------------------------------------------ labels
+#
+# Every registry below supports Prometheus-style labels: a series is
+# (name, labels) — ``serve.occupancy{replica="1"}`` — not a
+# string-concatenated metric name. Callers either pass ``labels={...}``
+# per call or bind them once with ``child(labels)``, which returns a view
+# with the same mutating API (the serving engine binds ``replica=<id>``
+# so one router run yields per-replica series without touching any call
+# site). ``child(None)`` returns the registry itself, so the unlabeled
+# path pays nothing.
+
+LabelSet = Tuple[Tuple[str, str], ...]
+
+
+def _labelset(labels: Optional[Dict[str, Any]]) -> LabelSet:
+    """Canonical (sorted, stringified) form — the dict-key half of a
+    series identity."""
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def render_series(name: str, labelset: LabelSet) -> str:
+    """Human/snapshot rendering: ``name{k="v",...}`` (bare name when
+    unlabeled) — matches the Prometheus exposition sample syntax."""
+    if not labelset:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in labelset)
+    return f"{name}{{{inner}}}"
+
+
+class _ChildView:
+    """A registry view with labels pre-bound. Forwards every call with the
+    bound labels merged under any per-call labels (call-site wins on key
+    collision). Children of children compose."""
+
+    def __init__(self, base, labels: Dict[str, Any]):
+        self._base = base
+        self._labels = {str(k): str(v) for k, v in labels.items()}
+
+    def _merge(self, labels: Optional[Dict[str, Any]]) -> Dict[str, str]:
+        if not labels:
+            return self._labels
+        return {**self._labels, **{str(k): str(v) for k, v in labels.items()}}
+
+    def child(self, labels: Optional[Dict[str, Any]] = None):
+        if not labels:
+            return self
+        return _ChildView(self._base, self._merge(labels))
+
+    # forwarded API (whichever of these the base registry has)
+    def inc(self, name, n=1, labels=None):
+        return self._base.inc(name, n, labels=self._merge(labels))
+
+    def set(self, name, value, labels=None):
+        return self._base.set(name, value, labels=self._merge(labels))
+
+    def observe(self, name, value, labels=None, **kw):
+        return self._base.observe(name, value, labels=self._merge(labels), **kw)
+
+    def get(self, name, *a, labels=None, **kw):
+        return self._base.get(name, *a, labels=self._merge(labels), **kw)
+
 
 class Counters:
     """Process-wide named counters for fault accounting (docs/DESIGN.md §9).
@@ -29,23 +92,40 @@ class Counters:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {}
+        self._counts: Dict[Tuple[str, LabelSet], int] = {}
 
-    def inc(self, name: str, n: int = 1) -> int:
+    def inc(self, name: str, n: int = 1,
+            labels: Optional[Dict[str, Any]] = None) -> int:
+        key = (name, _labelset(labels))
         with self._lock:
-            self._counts[name] = self._counts.get(name, 0) + n
-            return self._counts[name]
+            self._counts[key] = self._counts.get(key, 0) + n
+            return self._counts[key]
 
-    def get(self, name: str) -> int:
+    def get(self, name: str, labels: Optional[Dict[str, Any]] = None) -> int:
         with self._lock:
-            return self._counts.get(name, 0)
+            return self._counts.get((name, _labelset(labels)), 0)
+
+    def total(self, name: str) -> int:
+        """Sum over every label variant of ``name`` (the unlabeled series
+        included) — the fleet aggregate of a per-replica counter."""
+        with self._lock:
+            return sum(v for (n, _), v in self._counts.items() if n == name)
+
+    def child(self, labels: Optional[Dict[str, Any]] = None):
+        return self if not labels else _ChildView(self, labels)
+
+    def series(self, prefix: str = "") -> List[Tuple[str, LabelSet, int]]:
+        """(name, labelset, value) triples — the exposition-layer view."""
+        with self._lock:
+            return sorted(
+                (n, ls, v) for (n, ls), v in self._counts.items()
+                if n.startswith(prefix)
+            )
 
     def snapshot(self, prefix: str = "") -> Dict[str, int]:
-        with self._lock:
-            return {
-                k: v for k, v in sorted(self._counts.items())
-                if k.startswith(prefix)
-            }
+        return {
+            render_series(n, ls): v for n, ls, v in self.series(prefix)
+        }
 
     def reset(self) -> None:
         with self._lock:
@@ -64,22 +144,32 @@ class Gauges:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._values: Dict[str, float] = {}
+        self._values: Dict[Tuple[str, LabelSet], float] = {}
 
-    def set(self, name: str, value: float) -> None:
+    def set(self, name: str, value: float,
+            labels: Optional[Dict[str, Any]] = None) -> None:
         with self._lock:
-            self._values[name] = float(value)
+            self._values[(name, _labelset(labels))] = float(value)
 
-    def get(self, name: str, default: float = 0.0) -> float:
+    def get(self, name: str, default: float = 0.0,
+            labels: Optional[Dict[str, Any]] = None) -> float:
         with self._lock:
-            return self._values.get(name, default)
+            return self._values.get((name, _labelset(labels)), default)
+
+    def child(self, labels: Optional[Dict[str, Any]] = None):
+        return self if not labels else _ChildView(self, labels)
+
+    def series(self, prefix: str = "") -> List[Tuple[str, LabelSet, float]]:
+        with self._lock:
+            return sorted(
+                (n, ls, v) for (n, ls), v in self._values.items()
+                if n.startswith(prefix)
+            )
 
     def snapshot(self, prefix: str = "") -> Dict[str, float]:
-        with self._lock:
-            return {
-                k: v for k, v in sorted(self._values.items())
-                if k.startswith(prefix)
-            }
+        return {
+            render_series(n, ls): v for n, ls, v in self.series(prefix)
+        }
 
     def reset(self) -> None:
         with self._lock:
@@ -187,27 +277,41 @@ class Histograms:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._hists: Dict[str, Histogram] = {}
+        self._hists: Dict[Tuple[str, LabelSet], Histogram] = {}
 
-    def observe(self, name: str, value: float, **hist_kw) -> None:
+    def observe(self, name: str, value: float,
+                labels: Optional[Dict[str, Any]] = None, **hist_kw) -> None:
+        key = (name, _labelset(labels))
         with self._lock:
-            h = self._hists.get(name)
+            h = self._hists.get(key)
             if h is None:
-                h = self._hists[name] = Histogram(**hist_kw)
+                h = self._hists[key] = Histogram(**hist_kw)
         h.observe(value)
 
-    def get(self, name: str) -> Optional[Histogram]:
+    def get(self, name: str,
+            labels: Optional[Dict[str, Any]] = None) -> Optional[Histogram]:
         with self._lock:
-            return self._hists.get(name)
+            return self._hists.get((name, _labelset(labels)))
+
+    def child(self, labels: Optional[Dict[str, Any]] = None):
+        return self if not labels else _ChildView(self, labels)
+
+    def series(self, prefix: str = "") -> List[Tuple[str, LabelSet, Histogram]]:
+        with self._lock:
+            return sorted(
+                ((n, ls, h) for (n, ls), h in self._hists.items()
+                 if n.startswith(prefix)),
+                key=lambda t: (t[0], t[1]),
+            )
 
     def snapshot(self, prefix: str = "") -> Dict[str, Dict[str, float]]:
-        with self._lock:
-            items = sorted(self._hists.items())
-        return {k: h.snapshot() for k, h in items if k.startswith(prefix)}
+        return {
+            render_series(n, ls): h.snapshot() for n, ls, h in self.series(prefix)
+        }
 
     def items(self) -> List[Tuple[str, Histogram]]:
-        with self._lock:
-            return sorted(self._hists.items())
+        """Unlabeled-compatible view: (rendered name, Histogram) pairs."""
+        return [(render_series(n, ls), h) for n, ls, h in self.series()]
 
     def reset(self) -> None:
         with self._lock:

@@ -314,30 +314,49 @@ class Telemetry:
         s = "".join(out)
         return ("_" + s) if s and s[0].isdigit() else (s or "_")
 
+    @staticmethod
+    def _prom_labels(labelset, extra: str = "") -> str:
+        """Render a metrics ``LabelSet`` (plus an optional pre-rendered
+        pair like ``le="..."``) as a ``{...}`` sample suffix."""
+        parts = [f'{k}="{v}"' for k, v in labelset]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
     def dump(self) -> str:
         """Prometheus-style text exposition of every counter, gauge, and
-        histogram in ``utils.metrics`` plus the telemetry self-metrics."""
+        histogram in ``utils.metrics`` plus the telemetry self-metrics.
+        Labeled series (``serve.occupancy{replica="1"}`` — per-replica
+        serving metrics) render as proper label'd samples sharing one
+        ``# TYPE`` line per metric name."""
         lines: List[str] = []
-        for name, v in counters.snapshot().items():
+        typed: set = set()
+
+        def type_line(n: str, kind: str) -> None:
+            if n not in typed:
+                typed.add(n)
+                lines.append(f"# TYPE {n} {kind}")
+
+        for name, labelset, v in counters.series():
             n = self._prom_name(name)
-            lines.append(f"# TYPE {n} counter")
-            lines.append(f"{n} {v}")
-        for name, v in gauges.snapshot().items():
+            type_line(n, "counter")
+            lines.append(f"{n}{self._prom_labels(labelset)} {v}")
+        for name, labelset, v in gauges.series():
             n = self._prom_name(name)
-            lines.append(f"# TYPE {n} gauge")
-            lines.append(f"{n} {v:g}")
-        for name, hist in histograms.items():
+            type_line(n, "gauge")
+            lines.append(f"{n}{self._prom_labels(labelset)} {v:g}")
+        for name, labelset, hist in histograms.series():
             n = self._prom_name(name)
-            lines.append(f"# TYPE {n} histogram")
+            type_line(n, "histogram")
             for ub, cum in hist.buckets():
                 le = "+Inf" if ub == float("inf") else f"{ub:.6g}"
-                lines.append(f'{n}_bucket{{le="{le}"}} {cum}')
-            lines.append(f"{n}_sum {hist.sum:.9g}")
-            lines.append(f"{n}_count {hist.count}")
+                suffix = self._prom_labels(labelset, f'le="{le}"')
+                lines.append(f"{n}_bucket{suffix} {cum}")
+            lines.append(f"{n}_sum{self._prom_labels(labelset)} {hist.sum:.9g}")
+            lines.append(f"{n}_count{self._prom_labels(labelset)} {hist.count}")
             for q, label in ((50, "0.5"), (95, "0.95"), (99, "0.99")):
-                lines.append(
-                    f'{n}{{quantile="{label}"}} {hist.percentile(q):.9g}'
-                )
+                suffix = self._prom_labels(labelset, f'quantile="{label}"')
+                lines.append(f"{n}{suffix} {hist.percentile(q):.9g}")
         lines.append("# TYPE telemetry_ring_dropped counter")
         lines.append(f"telemetry_ring_dropped {self.dropped}")
         lines.append("# TYPE telemetry_sink_errors counter")

@@ -69,7 +69,9 @@ from dalle_pytorch_tpu.utils.faults import KNOWN_SITES as _SITES  # noqa: E402
 assert not _FAULTS.active(), "fault registry armed at session start"
 for _site in ("page_exhaust", "prefill_fail", "decode_stall",
               "request_cancel", "download", "ckpt_corrupt",
-              "telemetry_sink_fail"):
+              "telemetry_sink_fail",
+              # fleet sites (serving/router.py, PR 6)
+              "replica_crash", "replica_stall", "health_flap"):
     assert _site in _SITES, f"production fault site {_site!r} unregistered"
 
 import pytest  # noqa: E402

@@ -19,6 +19,20 @@ pass runs FIRST, so an armed ``prefill_fail`` fires at CHUNK granularity
 and the retry must resume from the last completed chunk::
 
     DALLE_TPU_FAULTS="prefill_fail=1" python tools/serve_smoke.py
+
+``--replicas N`` additionally drives the replicated front door
+(serving/router.py) through a chaos drill: N replicas serve 2N chunked
+requests, ``replica_crash`` is armed MID-RUN to kill the busiest
+replica, and the gate requires every request to COMPLETE with tokens
+bit-identical to a no-crash router pass — the cross-replica failover
+contract. Env-armed faults compose with the drill the same way::
+
+    DALLE_TPU_FAULTS="prefill_fail=1" python tools/serve_smoke.py --replicas 2
+
+Accounting everywhere is asserted through the PUBLIC
+``Engine.verify_invariants`` / ``Router.verify_invariants`` — the gate
+checks the same invariant surface the router's health machine probes in
+production, not a private test helper.
 """
 
 from __future__ import annotations
@@ -54,11 +68,80 @@ def build_tiny_model():
     return dalle, params
 
 
-def main() -> int:
+def run_replicated_drill(dalle, params, n_replicas: int) -> bool:
+    """The --replicas chaos drill: kill one replica mid-run, require all
+    requests COMPLETE with tokens bit-identical to a no-crash pass."""
     import numpy as np
 
     from dalle_pytorch_tpu.serving import (
-        Engine, EngineConfig, FakeClock, Outcome, Request, check_accounting,
+        EngineConfig, Outcome, Request, Router, RouterConfig,
+    )
+    from dalle_pytorch_tpu.utils.faults import FAULTS
+
+    rng = np.random.RandomState(2)
+    n_req = 2 * n_replicas
+    prompts = [
+        rng.randint(1, 16, size=(4,)).astype(np.int32) for _ in range(n_req)
+    ]
+
+    def run_pass(crash: bool):
+        router = Router(
+            dalle, params,
+            RouterConfig(n_replicas=n_replicas),
+            EngineConfig(max_batch=2, prefill_chunk=2),
+        )
+        for i in range(n_req):
+            assert router.submit(Request(
+                request_id=f"rep{i}", prompt=prompts[i],
+                max_new_tokens=dalle.image_seq_len, seed=100 + i,
+            )) is None
+        steps = 0
+        while router.step():
+            steps += 1
+            assert steps < 2000, "replicated drill made no progress"
+            # arm the kill once work is demonstrably in flight (mid-run),
+            # exactly once per pass
+            if crash and steps == 3:
+                FAULTS.arm("replica_crash", 1)
+        router.verify_invariants()
+        return router
+
+    clean = run_pass(crash=False)
+    chaos = run_pass(crash=True)
+    ok = True
+    dead = [s for s in chaos.replica_states().values() if s == "dead"]
+    if len(dead) != 1:
+        ok = False
+        print(f"serve smoke FAILED: replica drill expected 1 dead replica, "
+              f"states {chaos.replica_states()}", file=sys.stderr)
+    for i in range(n_req):
+        rid = f"rep{i}"
+        res = chaos.results[rid]
+        print(json.dumps({"pass": "replicated_chaos", **res.to_json()}))
+        if res.outcome is not Outcome.COMPLETED:
+            ok = False
+            print(f"serve smoke FAILED: {rid} did not complete under "
+                  f"replica_crash ({res.outcome.value})", file=sys.stderr)
+        elif not np.array_equal(
+            np.asarray(res.tokens), np.asarray(clean.results[rid].tokens)
+        ):
+            ok = False
+            print(f"serve smoke FAILED: {rid} tokens diverged across "
+                  "replica failover", file=sys.stderr)
+    print(json.dumps({"pass": "replicated_chaos", "stats": chaos.stats()}))
+    return ok
+
+
+def main(argv=None) -> int:
+    import numpy as np
+
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, FakeClock, Outcome, Request,
+    )
+
+    argv = sys.argv[1:] if argv is None else argv
+    n_replicas = (
+        int(argv[argv.index("--replicas") + 1]) if "--replicas" in argv else 0
     )
 
     dalle, params = build_tiny_model()
@@ -76,7 +159,7 @@ def main() -> int:
             ))
             assert rejected is None, rejected
         results = engine.run(max_steps=1000)
-        check_accounting(engine)
+        engine.verify_invariants(idle=True)
         for rid in sorted(results):
             print(json.dumps({"pass": label, **results[rid].to_json()}))
         print(json.dumps({"pass": label, "stats": engine.stats()}))
@@ -113,7 +196,7 @@ def main() -> int:
         max_new_tokens=dalle.image_seq_len, seed=0, deadline=0.5,
     )) is None
     drill.run(max_steps=100)
-    check_accounting(drill)
+    drill.verify_invariants(idle=True)
     res = drill.results["drill"]
     print(json.dumps({"pass": "mid_prefill_deadline", **res.to_json()}))
     if res.outcome is not Outcome.DEADLINE_EXCEEDED or res.tokens is not None:
@@ -126,11 +209,17 @@ def main() -> int:
         print("serve smoke FAILED: mid-prefill termination leaked "
               f"{drill.pool.used} pages", file=sys.stderr)
 
+    if n_replicas:
+        ok = run_replicated_drill(dalle, params, n_replicas) and ok
+
     if not ok:
         print("serve smoke FAILED: not every request completed", file=sys.stderr)
         return 1
     print("serve smoke OK: 3/3 completed chunked AND monolithic "
-          "(bit-identical), mid-prefill deadline drill typed, pool drained",
+          "(bit-identical), mid-prefill deadline drill typed, pool drained"
+          + (f", {2 * n_replicas}/{2 * n_replicas} completed the "
+             f"{n_replicas}-replica crash drill bit-identically"
+             if n_replicas else ""),
           file=sys.stderr)
     return 0
 

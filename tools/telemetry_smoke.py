@@ -18,7 +18,13 @@ release needs (docs/DESIGN.md §9):
 4. the long-prompt-arrival-during-steady-decode interference scenario
    (bench.py:bench_serve_interference, quick mode on the tiny model)
    runs with the recorder on, its max-decode-gap metric is finite, and
-   the spans it adds still balance.
+   the spans it adds still balance;
+5. a 2-replica router pass (serving/router.py) runs traced: every
+   request gets a balanced ``router.request`` span chain ending typed,
+   the per-replica labeled series (``serve_submitted{replica="0"}``)
+   render in the exposition, and ``Engine.verify_invariants`` /
+   ``Router.verify_invariants`` — the same public invariant surface the
+   router's health machine probes — hold after the run.
 
 Exit 0 iff all hold::
 
@@ -153,6 +159,51 @@ def main(argv=None) -> int:
         check(isummary["unclosed"] == [],
               f"interference spans left open: {isummary['unclosed_records']}")
 
+    # -- 5. replicated front door, traced ---------------------------------
+    import numpy as np
+
+    from dalle_pytorch_tpu.serving import (
+        EngineConfig, Outcome, Request, Router, RouterConfig,
+    )
+
+    dalle, params = serve_smoke.build_tiny_model()
+    router = Router(
+        dalle, params, RouterConfig(n_replicas=2),
+        EngineConfig(max_batch=2, prefill_chunk=2),
+    )
+    rng = np.random.RandomState(3)
+    for i in range(4):
+        router.submit(Request(
+            request_id=f"router{i}",
+            prompt=rng.randint(1, 16, size=(4,)).astype(np.int32),
+            max_new_tokens=dalle.image_seq_len, seed=200 + i,
+        ))
+    router.run(max_steps=2000)
+    router.verify_invariants()          # fleet-level accounting
+    for r in router._replicas:
+        r.engine.verify_invariants(idle=True)  # each engine, idle-strict
+    check(
+        all(res.outcome is Outcome.COMPLETED
+            for res in router.results.values()),
+        f"router pass outcomes: {[r.outcome.value for r in router.results.values()]}",
+    )
+    rpath = TELEMETRY.drain("router")
+    check(rpath is not None, "router drain produced no flight file")
+    router_spans = 0
+    if rpath is not None:
+        rsummary = validate_flight_file(rpath)
+        check(rsummary["unclosed"] == [],
+              f"router spans left open: {rsummary['unclosed_records']}")
+        router_spans = rsummary["by_name"].get("router.request", 0) // 2
+        check(router_spans >= 4,
+              f"expected >=4 router.request spans, saw {router_spans}")
+    dump = TELEMETRY.dump()
+    for series in ('serve_submitted{replica="0"}',
+                   'serve_submitted{replica="1"}',
+                   "router_completed", "router_queued"):
+        check(series in dump,
+              f"per-replica/router series {series!r} missing from /metrics")
+
     print(json.dumps({
         "flight_file": path,
         "records": summary["records"],
@@ -163,12 +214,14 @@ def main(argv=None) -> int:
         "interference_max_gap_ms": interference["value"],
         "interference_monolithic_max_gap_ms":
             interference["monolithic_max_gap_ms"],
+        "router_request_spans": router_spans,
     }))
     if not ok:
         return 1
     print(f"telemetry smoke OK: {n_req} request span chains balanced, "
           f"{summary['records']} records, /metrics renders, interference "
-          f"scenario traced", file=sys.stderr)
+          f"scenario traced, router pass traced with per-replica series",
+          file=sys.stderr)
     return 0
 
 
