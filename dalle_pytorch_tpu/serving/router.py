@@ -86,6 +86,7 @@ in flight, same contract as §9's crash captures.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Dict, List, Optional
@@ -202,13 +203,31 @@ class _Replica:
 
 
 class Router:
-    """See module docstring. Host-side fleet policy + N engines."""
+    """See module docstring. Host-side fleet policy + N engines.
+
+    Thread-safety: the router is the front door, so ``submit``/``cancel``
+    may be called from serving threads while another thread drives
+    ``run()``. All fleet-level bookkeeping (queue, results, live set,
+    spans, tallies) is guarded by one RLock — ``_GUARDED_BY`` below is
+    the machine-checked contract (tools/lint.py DTL051, docs/DESIGN.md
+    §11); internal helpers use the ``*_locked`` caller-holds-the-lock
+    convention. Each ``Engine`` stays single-threaded by design: only
+    ``step()`` (under the lock) ever touches a replica's engine, so the
+    engines need no locks of their own. Reentrancy (RLock) matters
+    because an engine's ``fleet_occupancy`` hook calls back into the
+    router mid-``step``."""
+
+    _GUARDED_BY = {
+        "_lock": ("_queue", "results", "_live", "_spans",
+                  "_outcome_counts", "_seq", "_submitted"),
+    }
 
     def __init__(self, dalle, params, config: RouterConfig = RouterConfig(),
                  engine_config: EngineConfig = EngineConfig(),
                  clock: Optional[Clock] = None):
         assert config.n_replicas >= 1, config.n_replicas
         self.config = config
+        self._lock = threading.RLock()
         self.clock = clock or Clock()
         now = self.clock.now()
         self._replicas: List[_Replica] = [
@@ -236,115 +255,139 @@ class Router:
     def submit(self, request: Request) -> Optional[RequestResult]:
         """Queue a request with the fleet; same contract as
         ``Engine.submit`` — an immediate typed reject returns the result,
-        otherwise None and the result lands in ``self.results``."""
+        otherwise None and the result lands in ``self.results``.
+        Thread-safe: callable from serving threads while another thread
+        drives ``run()``."""
         proto = self._replicas[0].engine
         if not (0 < request.max_new_tokens <= proto.dalle.image_seq_len):
             raise ValueError(
                 f"max_new_tokens must be in [1, {proto.dalle.image_seq_len}], "
                 f"got {request.max_new_tokens}"
             )
-        if request.request_id in self.results or request.request_id in self._live:
-            raise ValueError(f"duplicate request_id {request.request_id!r}")
-        self._submitted += 1
-        counters.inc("router.submitted")
-        now = self.clock.now()
-        self._spans[request.request_id] = TELEMETRY.begin(
-            "router.request", request_id=request.request_id,
-            priority=request.priority,
-        )
-        entry = _RouterEntry(request=request, seq=self._seq, submit_time=now)
-        self._seq += 1
-        live = [r for r in self._replicas if r.state is not ReplicaState.DEAD]
-        if not live:
-            return self._reject(entry, RejectReason.NO_REPLICA)
-        # worst-case demand vs the LARGEST live pool: a request no replica
-        # could ever hold is dead on arrival, fleet-wide
-        worst = proto._worst_case_pages(request.max_new_tokens)
-        if worst > max(r.engine.pool.total for r in live):
-            return self._reject(entry, RejectReason.DEMAND_EXCEEDS_POOL)
-        if len(self._queue) >= self.config.queue_limit:
-            TELEMETRY.event(
-                "router.shed", request_id=request.request_id,
-                queued=len(self._queue),
+        with self._lock:
+            if request.request_id in self.results or request.request_id in self._live:
+                raise ValueError(f"duplicate request_id {request.request_id!r}")
+            self._submitted += 1
+            counters.inc("router.submitted")
+            now = self.clock.now()
+            self._spans[request.request_id] = TELEMETRY.begin(
+                "router.request", request_id=request.request_id,
+                priority=request.priority,
             )
-            counters.inc("router.shed")
-            return self._reject(entry, RejectReason.QUEUE_FULL)
-        self._queue.append(entry)
-        self._live.add(request.request_id)
-        return None
+            entry = _RouterEntry(request=request, seq=self._seq, submit_time=now)
+            self._seq += 1
+            live = [
+                r for r in self._replicas if r.state is not ReplicaState.DEAD
+            ]
+            if not live:
+                return self._reject_locked(entry, RejectReason.NO_REPLICA)
+            # worst-case demand vs the LARGEST live pool: a request no
+            # replica could ever hold is dead on arrival, fleet-wide
+            worst = proto._worst_case_pages(request.max_new_tokens)
+            if worst > max(r.engine.pool.total for r in live):
+                return self._reject_locked(
+                    entry, RejectReason.DEMAND_EXCEEDS_POOL
+                )
+            if len(self._queue) >= self.config.queue_limit:
+                TELEMETRY.event(
+                    "router.shed", request_id=request.request_id,
+                    queued=len(self._queue),
+                )
+                counters.inc("router.shed")
+                return self._reject_locked(entry, RejectReason.QUEUE_FULL)
+            self._queue.append(entry)
+            self._live.add(request.request_id)
+            return None
 
     def cancel(self, request_id: str) -> None:
         """Cancel wherever the request currently lives: still queued at
         the router => terminal here next sweep; in flight on a replica =>
         forwarded to that engine (takes effect between its iterations)."""
-        for entry in self._queue:
-            if entry.request_id == request_id:
-                self._queue.remove(entry)
-                self._finish(entry, RequestResult(
-                    request_id=request_id, outcome=Outcome.CANCELLED,
-                    total_latency_s=self.clock.now() - entry.submit_time,
-                ))
-                return
-        for r in self._replicas:
-            if r.state is not ReplicaState.DEAD and request_id in r.inflight:
-                r.engine.cancel(request_id)
-                return
+        with self._lock:
+            for entry in self._queue:
+                if entry.request_id == request_id:
+                    self._queue.remove(entry)
+                    self._finish_locked(entry, RequestResult(
+                        request_id=request_id, outcome=Outcome.CANCELLED,
+                        total_latency_s=self.clock.now() - entry.submit_time,
+                    ))
+                    return
+            for r in self._replicas:
+                if r.state is not ReplicaState.DEAD and request_id in r.inflight:
+                    r.engine.cancel(request_id)
+                    return
 
     def drain(self, replica_id: int) -> None:
         """Graceful drain: stop admitting to the replica, let in-flight
         work finish, then retire it. Requests still queued at the router
         simply route to siblings (the ``can_admit`` dispatch gate means a
         replica's internal queue is already empty)."""
-        r = self._replicas[replica_id]
-        if r.state in (ReplicaState.DEAD, ReplicaState.DRAINING):
-            return
-        r.state = ReplicaState.DRAINING
-        counters.inc("router.drains")
-        TELEMETRY.event(
-            "router.drain", replica=r.id, inflight=len(r.inflight),
-        )
+        with self._lock:
+            r = self._replicas[replica_id]
+            if r.state in (ReplicaState.DEAD, ReplicaState.DRAINING):
+                return
+            r.state = ReplicaState.DRAINING
+            counters.inc("router.drains")
+            TELEMETRY.event(
+                "router.drain", replica=r.id, inflight=len(r.inflight),
+            )
+
+    def kill(self, replica_id: int, reason: str = "operator") -> None:
+        """Declare a replica DEAD *now* and fail its in-flight work over
+        to siblings — the abrupt form of ``drain`` (operator action or a
+        test simulating a crash the fault registry didn't inject)."""
+        with self._lock:
+            r = self._replicas[replica_id]
+            if r.state is not ReplicaState.DEAD:
+                self._kill_locked(r, reason)
 
     def step(self) -> bool:
         """One fleet scheduling iteration: fault injections -> router
         deadline sweep -> drive + harvest every live replica -> health
         checks -> retire finished drains -> dispatch -> all-dead flush.
-        Returns False when the fleet is fully idle."""
-        self._inject_faults()
-        self._sweep_queue_deadlines()
-        stepped = 0
-        for r in self._replicas:
-            if r.state is ReplicaState.DEAD:
-                continue
-            if r.skip_steps > 0:
-                r.skip_steps -= 1   # injected stall: the engine hangs
-            else:
-                r.engine.step()
-                stepped += 1
-            self._harvest(r)
-        for r in self._replicas:
-            if r.state is not ReplicaState.DEAD:
-                self._health_check(r)
-        for r in self._replicas:
-            if (
-                r.state is ReplicaState.DRAINING
-                and not r.inflight
-                and not any(r.engine.slots)
-                and not len(r.engine.sched)
-            ):
-                r.state = ReplicaState.DEAD
-                r.death_reason = "drained"
-                counters.inc("router.drained")
-                TELEMETRY.event("router.drained", replica=r.id)
-        self._dispatch()
-        if all(r.state is ReplicaState.DEAD for r in self._replicas):
-            self._flush_no_replica()
-        if stepped == 0:
-            # every replica dead/stalled: time must still advance (engine
-            # steps normally tick the shared clock) or deadline sweeps and
-            # the stall heartbeat itself would freeze with it
-            self.clock.tick()
-        self._publish_gauges()
-        return bool(self._queue) or any(r.inflight for r in self._replicas)
+        Returns False when the fleet is fully idle. The whole iteration
+        runs under the router lock: concurrent ``submit``/``cancel``
+        land between iterations, never inside one."""
+        with self._lock:
+            self._inject_faults_locked()
+            self._sweep_queue_deadlines_locked()
+            stepped = 0
+            for r in self._replicas:
+                if r.state is ReplicaState.DEAD:
+                    continue
+                if r.skip_steps > 0:
+                    r.skip_steps -= 1   # injected stall: the engine hangs
+                else:
+                    r.engine.step()
+                    stepped += 1
+                self._harvest_locked(r)
+            for r in self._replicas:
+                if r.state is not ReplicaState.DEAD:
+                    self._health_check_locked(r)
+            for r in self._replicas:
+                if (
+                    r.state is ReplicaState.DRAINING
+                    and not r.inflight
+                    and not any(r.engine.slots)
+                    and not len(r.engine.sched)
+                ):
+                    r.state = ReplicaState.DEAD
+                    r.death_reason = "drained"
+                    counters.inc("router.drained")
+                    TELEMETRY.event("router.drained", replica=r.id)
+            self._dispatch_locked()
+            if all(r.state is ReplicaState.DEAD for r in self._replicas):
+                self._flush_no_replica_locked()
+            if stepped == 0:
+                # every replica dead/stalled: time must still advance
+                # (engine steps normally tick the shared clock) or
+                # deadline sweeps and the stall heartbeat itself would
+                # freeze with it
+                self.clock.tick()
+            self._publish_gauges_locked()
+            return bool(self._queue) or any(
+                r.inflight for r in self._replicas
+            )
 
     def run(self, max_steps: Optional[int] = None) -> Dict[str, RequestResult]:
         """Drive until idle; ``max_steps`` is the same loud safety valve
@@ -353,45 +396,56 @@ class Router:
         while self.step():
             steps += 1
             if max_steps is not None and steps >= max_steps:
-                raise RuntimeError(
-                    f"router made no terminal progress in {max_steps} steps: "
-                    f"{len(self._queue)} queued, "
-                    f"{sum(len(r.inflight) for r in self._replicas)} in flight"
-                )
-        return self.results
+                with self._lock:
+                    raise RuntimeError(
+                        f"router made no terminal progress in {max_steps} "
+                        f"steps: {len(self._queue)} queued, "
+                        f"{sum(len(r.inflight) for r in self._replicas)} "
+                        f"in flight"
+                    )
+        with self._lock:
+            return self.results
 
     def fleet_occupancy(self) -> float:
         """Aggregate page occupancy over LIVE replicas — capacity lost to
         a dead sibling raises the remaining fleet's pressure, which is
-        what lets the watermark clamp degrade admissions fleet-wide."""
-        live = [r for r in self._replicas if r.state is not ReplicaState.DEAD]
-        total = sum(r.engine.pool.total for r in live)
-        if total == 0:
-            return 1.0
-        return sum(r.engine.pool.used for r in live) / total
+        what lets the watermark clamp degrade admissions fleet-wide.
+        Locked: a monitoring thread must never read replica states and
+        pool tallies mid-``step`` (reentrant for the engine's own
+        mid-step callback — the RLock)."""
+        with self._lock:
+            live = [
+                r for r in self._replicas if r.state is not ReplicaState.DEAD
+            ]
+            total = sum(r.engine.pool.total for r in live)
+            if total == 0:
+                return 1.0
+            return sum(r.engine.pool.used for r in live) / total
 
     def replica_states(self) -> Dict[int, str]:
-        return {r.id: r.state.value for r in self._replicas}
+        with self._lock:
+            return {r.id: r.state.value for r in self._replicas}
 
     def stats(self) -> dict:
-        return {
-            "submitted": self._submitted,
-            "queued": len(self._queue),
-            "fleet_occupancy": self.fleet_occupancy(),
-            "outcomes": {
-                o.value: n for o, n in self._outcome_counts.items()
-            },
-            "replicas": {
-                r.id: {
-                    "state": r.state.value,
-                    "death_reason": r.death_reason,
-                    "inflight": len(r.inflight),
-                    "pool_occupancy": r.engine.pool.occupancy,
-                    "breaker_trips": r.breaker_trips,
-                }
-                for r in self._replicas
-            },
-        }
+        with self._lock:
+            return {
+                "submitted": self._submitted,
+                "queued": len(self._queue),
+                "fleet_occupancy": self.fleet_occupancy(),
+                "outcomes": {
+                    o.value: n for o, n in self._outcome_counts.items()
+                },
+                "replicas": {
+                    r.id: {
+                        "state": r.state.value,
+                        "death_reason": r.death_reason,
+                        "inflight": len(r.inflight),
+                        "pool_occupancy": r.engine.pool.occupancy,
+                        "breaker_trips": r.breaker_trips,
+                    }
+                    for r in self._replicas
+                },
+            }
 
     def verify_invariants(self) -> None:
         """Fleet-level accounting: every submitted request is live XOR has
@@ -399,41 +453,43 @@ class Router:
         set is exactly queue + in-flight, every live engine's own
         invariants hold, and every live engine's live requests are tracked
         by the router."""
-        inflight_ids = set()
-        for r in self._replicas:
-            assert not (inflight_ids & set(r.inflight)), "request on two replicas"
-            inflight_ids |= set(r.inflight)
-        queued_ids = {e.request_id for e in self._queue}
-        both = [rid for rid in self._live if rid in self.results]
-        assert not both, f"request both live and finished: {sorted(both)}"
-        assert len(self.results) + len(self._live) == self._submitted, (
-            f"{self._submitted} submitted but {len(self.results)} results "
-            f"+ {len(self._live)} live"
-        )
-        assert self._live == queued_ids | inflight_ids, (
-            f"live {sorted(self._live)} != queued {sorted(queued_ids)} | "
-            f"inflight {sorted(inflight_ids)}"
-        )
-        outcomes = self.stats()["outcomes"]
-        assert sum(outcomes.values()) == len(self.results), outcomes
-        for r in self._replicas:
-            if r.state is not ReplicaState.DEAD:
-                r.engine.verify_invariants()
-                assert r.engine._live <= set(r.inflight), (
-                    f"replica {r.id} serving untracked requests "
-                    f"{sorted(r.engine._live - set(r.inflight))}"
-                )
+        with self._lock:
+            inflight_ids = set()
+            for r in self._replicas:
+                assert not (inflight_ids & set(r.inflight)), \
+                    "request on two replicas"
+                inflight_ids |= set(r.inflight)
+            queued_ids = {e.request_id for e in self._queue}
+            both = [rid for rid in self._live if rid in self.results]
+            assert not both, f"request both live and finished: {sorted(both)}"
+            assert len(self.results) + len(self._live) == self._submitted, (
+                f"{self._submitted} submitted but {len(self.results)} results "
+                f"+ {len(self._live)} live"
+            )
+            assert self._live == queued_ids | inflight_ids, (
+                f"live {sorted(self._live)} != queued {sorted(queued_ids)} | "
+                f"inflight {sorted(inflight_ids)}"
+            )
+            outcomes = self.stats()["outcomes"]
+            assert sum(outcomes.values()) == len(self.results), outcomes
+            for r in self._replicas:
+                if r.state is not ReplicaState.DEAD:
+                    r.engine.verify_invariants()
+                    assert r.engine._live <= set(r.inflight), (
+                        f"replica {r.id} serving untracked requests "
+                        f"{sorted(r.engine._live - set(r.inflight))}"
+                    )
 
     # ---------------------------------------------------------- injections
 
-    def _inject_faults(self) -> None:
+    def _inject_faults_locked(self) -> None:
         # eligibility is checked BEFORE take(): an armed fault with no
         # eligible victim stays armed for the next iteration instead of
         # being silently swallowed
         victim = self._busiest_live()
         if victim is not None and FAULTS.take("replica_crash"):
             counters.inc("router.fault_replica_crash")
-            self._kill(victim, "crash")
+            self._kill_locked(victim, "crash")
             victim = self._busiest_live()
         if victim is not None and FAULTS.take("replica_stall"):
             counters.inc("router.fault_replica_stall")
@@ -443,7 +499,7 @@ class Router:
         ]
         if healthy and FAULTS.take("health_flap"):
             counters.inc("router.fault_health_flap")
-            self._open_breaker(healthy[0], "health_flap")
+            self._open_breaker_locked(healthy[0], "health_flap")
 
     def _busiest_live(self) -> Optional[_Replica]:
         live = [r for r in self._replicas if r.state is not ReplicaState.DEAD]
@@ -453,7 +509,7 @@ class Router:
 
     # ------------------------------------------------------------- health
 
-    def _health_check(self, r: _Replica) -> None:
+    def _health_check_locked(self, r: _Replica) -> None:
         # accounting invariant: a corrupt engine is dead NOW — routing
         # more work into it can only lose or duplicate requests
         try:
@@ -462,7 +518,7 @@ class Router:
             TELEMETRY.event(
                 "router.invariant_violation", replica=r.id, detail=str(e)[:200]
             )
-            self._kill(r, "invariant_violation")
+            self._kill_locked(r, "invariant_violation")
             return
         now = self.clock.now()
         # circuit breaker: consecutive prefill failures via counter deltas
@@ -479,7 +535,7 @@ class Router:
             r.state is ReplicaState.HEALTHY
             and r.breaker_consec >= self.config.breaker_threshold
         ):
-            self._open_breaker(r, "prefill_failures")
+            self._open_breaker_locked(r, "prefill_failures")
         # breaker readmission after backoff
         if (
             r.state is ReplicaState.DEGRADED
@@ -498,14 +554,14 @@ class Router:
             r.last_progress_val = progress
             r.last_progress_t = now
         elif now - r.last_progress_t > self.config.stall_timeout_s:
-            self._kill(r, "stall_timeout")
+            self._kill_locked(r, "stall_timeout")
 
-    def _open_breaker(self, r: _Replica, reason: str) -> None:
+    def _open_breaker_locked(self, r: _Replica, reason: str) -> None:
         policy = self.config.breaker_backoff
         r.breaker_trips += 1
         r.breaker_consec = 0
         if r.breaker_trips > max(1, policy.attempts):
-            self._kill(r, "breaker_exhausted")
+            self._kill_locked(r, "breaker_exhausted")
             return
         delay = min(
             policy.max_delay, policy.base_delay * (2 ** (r.breaker_trips - 1))
@@ -520,7 +576,7 @@ class Router:
 
     # ----------------------------------------------------------- failover
 
-    def _kill(self, r: _Replica, reason: str) -> None:
+    def _kill_locked(self, r: _Replica, reason: str) -> None:
         """Declare a replica dead and fail its in-flight work over. The
         engine is abandoned like a dead host: unharvested results are
         lost; requeued requests replay from scratch on a sibling —
@@ -537,7 +593,7 @@ class Router:
             entry.failovers += 1
             entry.crash_t0 = now
             if entry.failovers > self.config.max_failovers:
-                self._finish(entry, RequestResult(
+                self._finish_locked(entry, RequestResult(
                     request_id=rid, outcome=Outcome.PREEMPT_CAP,
                     preempt_count=entry.failovers,
                     total_latency_s=now - entry.submit_time,
@@ -548,13 +604,13 @@ class Router:
                 self._queue.append(entry)
         r.inflight.clear()
 
-    def _flush_no_replica(self) -> None:
+    def _flush_no_replica_locked(self) -> None:
         """Fleet fully dead: every queued request ends typed rather than
         hanging — the none-lost half of the accounting invariant."""
         for entry in list(self._queue):
             self._queue.remove(entry)
             counters.inc("router.no_replica")
-            self._finish(entry, RequestResult(
+            self._finish_locked(entry, RequestResult(
                 request_id=entry.request_id, outcome=Outcome.REJECTED,
                 reject_reason=RejectReason.NO_REPLICA,
                 total_latency_s=self.clock.now() - entry.submit_time,
@@ -563,20 +619,20 @@ class Router:
 
     # ----------------------------------------------------------- dispatch
 
-    def _sweep_queue_deadlines(self) -> None:
+    def _sweep_queue_deadlines_locked(self) -> None:
         now = self.clock.now()
         for entry in list(self._queue):
             d = entry.request.deadline
             if d is not None and now > d:
                 self._queue.remove(entry)
-                self._finish(entry, RequestResult(
+                self._finish_locked(entry, RequestResult(
                     request_id=entry.request_id,
                     outcome=Outcome.DEADLINE_EXCEEDED,
                     total_latency_s=now - entry.submit_time,
                     detail="deadline passed in router queue",
                 ))
 
-    def _dispatch(self) -> None:
+    def _dispatch_locked(self) -> None:
         """Route queued work: head-of-line in (priority, FIFO) order to
         the least-loaded admittable HEALTHY replica. Strict head-of-line
         (nothing behind a stuck head goes first) for the scheduler's
@@ -610,13 +666,13 @@ class Router:
             if rejected is not None:
                 # can_admit said yes but the engine refused — surface the
                 # engine's typed reason rather than hiding a router bug
-                self._finish(entry, rejected)
+                self._finish_locked(entry, rejected)
                 continue
             r.inflight[entry.request_id] = entry
 
     # ------------------------------------------------------------ harvest
 
-    def _harvest(self, r: _Replica) -> None:
+    def _harvest_locked(self, r: _Replica) -> None:
         for rid in list(r.inflight):
             res = r.engine.results.get(rid)
             if res is None:
@@ -626,21 +682,21 @@ class Router:
                 res.detail = (
                     f"{res.detail} (failovers={entry.failovers})".strip()
                 )
-            self._finish(entry, res)
+            self._finish_locked(entry, res)
 
     # ----------------------------------------------------------- plumbing
 
-    def _reject(self, entry: _RouterEntry, reason: RejectReason) -> RequestResult:
+    def _reject_locked(self, entry: _RouterEntry, reason: RejectReason) -> RequestResult:
         result = RequestResult(
             request_id=entry.request_id,
             outcome=Outcome.REJECTED,
             reject_reason=reason,
             total_latency_s=0.0,
         )
-        self._finish(entry, result)
+        self._finish_locked(entry, result)
         return result
 
-    def _finish(self, entry: _RouterEntry, result: RequestResult) -> None:
+    def _finish_locked(self, entry: _RouterEntry, result: RequestResult) -> None:
         assert entry.request_id not in self.results, (
             f"duplicate terminal result for {entry.request_id!r}"
         )
@@ -658,7 +714,7 @@ class Router:
             failovers=entry.failovers,
         )
 
-    def _publish_gauges(self) -> None:
+    def _publish_gauges_locked(self) -> None:
         gauges.set("router.queued", len(self._queue))
         gauges.set("router.fleet_occupancy", self.fleet_occupancy())
         gauges.set("router.replicas_live", sum(

@@ -34,6 +34,7 @@ from .schedules import (
     gumbel_temperature,
 )
 from .telemetry import TELEMETRY, Telemetry, validate_flight_file
+from . import telemetry_names
 
 __all__ = [
     "CACHE_DIR",
@@ -48,6 +49,7 @@ __all__ = [
     "MetricsLogger",
     "TELEMETRY",
     "Telemetry",
+    "telemetry_names",
     "PreemptionHandler",
     "ReduceLROnPlateau",
     "RetryPolicy",

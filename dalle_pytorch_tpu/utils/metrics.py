@@ -20,7 +20,7 @@ from typing import Any, Dict, List, Optional, Tuple
 # ------------------------------------------------------------------ labels
 #
 # Every registry below supports Prometheus-style labels: a series is
-# (name, labels) — ``serve.occupancy{replica="1"}`` — not a
+# (name, labels) — ``serve.pool_occupancy{replica="1"}`` — not a
 # string-concatenated metric name. Callers either pass ``labels={...}``
 # per call or bind them once with ``child(labels)``, which returns a view
 # with the same mutating API (the serving engine binds ``replica=<id>``
@@ -88,7 +88,11 @@ class Counters:
     retries) must be COUNTED, not just warned about — a run that silently
     dropped 30% of its shards looks healthy in the loss curve. Producers
     (data/webdata.py, utils/download.py) ``inc`` from loader threads;
-    the trainer snapshots into the step metrics. Thread-safe."""
+    the trainer snapshots into the step metrics. Thread-safe; the
+    ``_GUARDED_BY`` table is the machine-checked contract (tools/lint.py
+    DTL051, docs/DESIGN.md §11)."""
+
+    _GUARDED_BY = {"_lock": ("_counts",)}
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -141,6 +145,8 @@ class Gauges:
     running depths here each scheduling pass so an operator dashboard (or a
     test) reads the engine's current pressure without reaching into it.
     Thread-safe for the same reason Counters is."""
+
+    _GUARDED_BY = {"_lock": ("_values",)}
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -196,6 +202,8 @@ class Histogram:
     cheap enough for the serving engine's per-iteration path.
     """
 
+    _GUARDED_BY = {"_lock": ("_counts", "count", "sum", "min", "max")}
+
     def __init__(self, lo: float = 1e-6, hi: float = 1e3,
                  per_decade: int = 10):
         assert 0 < lo < hi and per_decade > 0
@@ -228,45 +236,72 @@ class Histogram:
         (Prometheus ``histogram_quantile`` convention, conservative
         direction). Overflow-bucket hits report the exact observed max."""
         with self._lock:
-            if self.count == 0:
-                return 0.0
-            rank = max(1, math.ceil(q / 100.0 * self.count))
-            seen = 0
-            for i, c in enumerate(self._counts):
-                seen += c
-                if seen >= rank:
-                    if i >= len(self.bounds):  # overflow
-                        return self.max
-                    return min(self.bounds[i], self.max)
-            return self.max  # unreachable; counts sum to self.count
+            return self._percentile_locked(q)
+
+    def _percentile_locked(self, q: float) -> float:
+        if self.count == 0:
+            return 0.0
+        rank = max(1, math.ceil(q / 100.0 * self.count))
+        seen = 0
+        for i, c in enumerate(self._counts):
+            seen += c
+            if seen >= rank:
+                if i >= len(self.bounds):  # overflow
+                    return self.max
+                return min(self.bounds[i], self.max)
+        return self.max  # unreachable; counts sum to self.count
 
     def snapshot(self) -> Dict[str, float]:
-        return {
-            "count": self.count,
-            "sum": self.sum,
-            "min": 0.0 if self.count == 0 else self.min,
-            "max": 0.0 if self.count == 0 else self.max,
-            "p50": self.percentile(50),
-            "p95": self.percentile(95),
-            "p99": self.percentile(99),
-        }
+        # one lock hold for the whole snapshot: the old unlocked reads
+        # could interleave with a concurrent observe() and report a count
+        # that disagrees with its own percentiles (surfaced by DTL051
+        # once Histogram declared its _GUARDED_BY table)
+        with self._lock:
+            return {
+                "count": self.count,
+                "sum": self.sum,
+                "min": 0.0 if self.count == 0 else self.min,
+                "max": 0.0 if self.count == 0 else self.max,
+                "p50": self._percentile_locked(50),
+                "p95": self._percentile_locked(95),
+                "p99": self._percentile_locked(99),
+            }
 
     def buckets(self) -> List[Tuple[float, int]]:
         """(upper_bound, CUMULATIVE count) pairs up to the last nonzero
         bucket, plus the (+Inf, total) terminator — the Prometheus
         ``_bucket{le=...}`` exposition shape."""
         with self._lock:
-            out: List[Tuple[float, int]] = []
-            cum = 0
-            last_nonzero = max(
-                (i for i, c in enumerate(self._counts) if c), default=-1
-            )
-            for i, c in enumerate(self._counts[: len(self.bounds)]):
-                cum += c
-                if i <= last_nonzero:
-                    out.append((self.bounds[i], cum))
-            out.append((math.inf, self.count))
-            return out
+            return self._buckets_locked()
+
+    def _buckets_locked(self) -> List[Tuple[float, int]]:
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        last_nonzero = max(
+            (i for i, c in enumerate(self._counts) if c), default=-1
+        )
+        for i, c in enumerate(self._counts[: len(self.bounds)]):
+            cum += c
+            if i <= last_nonzero:
+                out.append((self.bounds[i], cum))
+        out.append((math.inf, self.count))
+        return out
+
+    def exposition(self) -> Dict[str, Any]:
+        """Atomic snapshot for the Prometheus renderer: buckets, sum,
+        count, and quantiles from ONE lock hold — a concurrent observe()
+        between separate reads would otherwise render a ``_count`` that
+        disagrees with its own ``le="+Inf"`` bucket (Prometheus requires
+        them equal within a scrape)."""
+        with self._lock:
+            return {
+                "buckets": self._buckets_locked(),
+                "sum": self.sum,
+                "count": self.count,
+                "quantiles": {
+                    q: self._percentile_locked(q) for q in (50, 95, 99)
+                },
+            }
 
 
 class Histograms:
@@ -274,6 +309,8 @@ class Histograms:
     registry shape as ``Counters``/``Gauges`` so producers never
     pre-declare. The span API (utils/telemetry.py) feeds ``<span>_s``
     duration histograms here automatically."""
+
+    _GUARDED_BY = {"_lock": ("_hists",)}
 
     def __init__(self):
         self._lock = threading.Lock()

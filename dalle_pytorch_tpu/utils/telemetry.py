@@ -79,7 +79,14 @@ class _MonotonicClock:
 
 class Telemetry:
     """See module docstring. One process-wide instance (``TELEMETRY``)
-    is the normal entry point; tests build private ones."""
+    is the normal entry point; tests build private ones.
+
+    The ring state below is written from serve/train threads, loader
+    threads, AND re-entrantly from signal handlers; ``_GUARDED_BY`` is
+    the machine-checked contract for which fields the RLock guards
+    (tools/lint.py DTL051, docs/DESIGN.md §11)."""
+
+    _GUARDED_BY = {"_lock": ("_buf", "_open", "_next_id", "_flight_path")}
 
     def __init__(self, clock=None, ring_size: int = 4096):
         self._lock = threading.RLock()  # reentrant: drain can fire from a
@@ -250,7 +257,7 @@ class Telemetry:
             FAULTS.maybe_raise(
                 "telemetry_sink_fail", OSError("injected telemetry_sink_fail")
             )
-            path = self._flight_file()
+            path = self._flight_file_locked()
             lines = [json.dumps(rec, default=str) for rec in records]
             lines.append(json.dumps(
                 {"ts": self.clock.now(), "ph": "I",
@@ -282,9 +289,10 @@ class Telemetry:
                 pass
             return None
 
-    def _flight_file(self) -> str:
+    def _flight_file_locked(self) -> str:
         """Per-PID JSONL path; rotates (one generation, ``.1``) past
-        ``flight_max_bytes`` so a long-lived server bounds its disk use."""
+        ``flight_max_bytes`` so a long-lived server bounds its disk use.
+        ``_locked``: only called under ``_lock`` (from the drain)."""
         if self._flight_path is None:
             os.makedirs(self.flight_dir, exist_ok=True)
             self._flight_path = os.path.join(
@@ -348,15 +356,24 @@ class Telemetry:
         for name, labelset, hist in histograms.series():
             n = self._prom_name(name)
             type_line(n, "histogram")
-            for ub, cum in hist.buckets():
+            # one atomic snapshot per histogram: buckets/_sum/_count/
+            # quantiles must agree within a scrape (a concurrent
+            # observe() between separate locked reads would render a
+            # _count above the +Inf bucket)
+            exp = hist.exposition()
+            for ub, cum in exp["buckets"]:
                 le = "+Inf" if ub == float("inf") else f"{ub:.6g}"
                 suffix = self._prom_labels(labelset, f'le="{le}"')
                 lines.append(f"{n}_bucket{suffix} {cum}")
-            lines.append(f"{n}_sum{self._prom_labels(labelset)} {hist.sum:.9g}")
-            lines.append(f"{n}_count{self._prom_labels(labelset)} {hist.count}")
+            lines.append(
+                f"{n}_sum{self._prom_labels(labelset)} {exp['sum']:.9g}"
+            )
+            lines.append(
+                f"{n}_count{self._prom_labels(labelset)} {exp['count']}"
+            )
             for q, label in ((50, "0.5"), (95, "0.95"), (99, "0.99")):
                 suffix = self._prom_labels(labelset, f'quantile="{label}"')
-                lines.append(f"{n}{suffix} {hist.percentile(q):.9g}")
+                lines.append(f"{n}{suffix} {exp['quantiles'][q]:.9g}")
         lines.append("# TYPE telemetry_ring_dropped counter")
         lines.append(f"telemetry_ring_dropped {self.dropped}")
         lines.append("# TYPE telemetry_sink_errors counter")

@@ -1,0 +1,183 @@
+"""The single registry of telemetry names (docs/DESIGN.md §9 and §11).
+
+Every counter, gauge, histogram, span, and event name the package emits
+is declared here, per kind — one dot-separated namespace per subsystem
+(``serve.*`` engine, ``router.*`` front door, ``train.*`` trainer,
+``data.*``/``webdata.*`` loaders, ``download.*`` fetcher,
+``telemetry.*`` the layer itself). The static checker
+(``tools/lint.py``, finding DTL041) flags any literal passed to
+``counters.inc`` / ``gauges.set`` / ``histograms.observe`` /
+``TELEMETRY.span|begin|event`` that is not registered under the matching
+kind, and DTL042 flags registered names missing from the DESIGN.md §9
+tables — so the registry, the code, and the operator docs cannot drift.
+
+This module is parsed by AST (never imported) by the linter, so keep the
+sets as flat literals. It is also importable at runtime (host-side only,
+like the rest of the observability layer) for tools and tests that want
+to validate names programmatically.
+
+Dynamic names: a handful of call sites build names from enum values
+(``f"serve.{outcome.value}"``). Their full expansions are registered
+here explicitly — the checker validates the f-string's literal head
+against the registered names, so a renamed namespace still fails lint
+while a new enum member only needs its expansion added here.
+
+Span-duration histograms (``<span>_s``, auto-observed by
+utils/telemetry.py) are derived — see ``SPAN_DURATION_HISTOGRAMS`` —
+and are valid histogram names wherever bench/tools read them.
+"""
+
+from __future__ import annotations
+
+# --------------------------------------------------------------- spans
+
+SPANS = frozenset({
+    # serving engine (serving/engine.py)
+    "serve.request",        # submit -> typed outcome (the lifecycle span)
+    "serve.prefill",        # monolithic, or cross-iteration when chunked
+    "serve.prefill_chunk",  # one per chunk, synced in-span
+    "serve.slot_insert",
+    "serve.decode_step",    # one per DISPATCHED decode step
+    # replicated front door (serving/router.py)
+    "router.request",       # router submit -> typed outcome
+    # trainer (train_dalle.py)
+    "train.step",           # dispatch -> verdict (device-inclusive)
+    "train.data_wait",
+    "train.ckpt_save",
+})
+
+# -------------------------------------------------------------- events
+
+EVENTS = frozenset({
+    # serving engine
+    "serve.admit",
+    "serve.first_token",
+    "serve.evict",
+    "serve.decode_stall",
+    "serve.prefill_retry",
+    # replicated front door
+    "router.shed",
+    "router.drain",
+    "router.drained",
+    "router.failover",
+    "router.failover_dispatch",
+    "router.invariant_violation",
+    "router.breaker_open",
+    "router.readmit",
+    # trainer
+    "train.nan_skip",
+    "train.nan_abort",
+    "train.preempt_signal",
+    # data loaders (data/webdata.py)
+    "data.shard_open",
+    "data.shard_quarantined",
+    "data.shard_abort",
+})
+
+# ------------------------------------------------------------ counters
+
+COUNTERS = frozenset({
+    # serving engine lifecycle
+    "serve.submitted",
+    "serve.admitted",
+    "serve.completed",
+    "serve.rejected",
+    # typed-outcome tallies (f"serve.{outcome.value}" expansions)
+    "serve.deadline_exceeded",
+    "serve.cancelled",
+    "serve.preempt_cap",
+    "serve.prefill_failed",
+    # typed-reject tallies (f"serve.rejected.{reason.value}" expansions)
+    "serve.rejected.demand_exceeds_pool",
+    "serve.rejected.queue_full",
+    "serve.rejected.no_replica",
+    # engine work/robustness tallies
+    "serve.clamped",
+    "serve.preempted",
+    "serve.decode_steps",
+    "serve.prefill_chunks",
+    "serve.prefill_retries",
+    "serve.fault_request_cancel",
+    "serve.fault_prefill_fail",
+    "serve.fault_decode_stall",
+    "serve.fault_page_exhaust",
+    # replicated front door
+    "router.submitted",
+    "router.shed",
+    "router.drains",
+    "router.drained",
+    "router.readmits",
+    "router.breaker_opens",
+    "router.replica_deaths",
+    "router.failovers",
+    "router.no_replica",
+    "router.fault_replica_crash",
+    "router.fault_replica_stall",
+    "router.fault_health_flap",
+    # typed-outcome tallies (f"router.{outcome.value}" expansions)
+    "router.completed",
+    "router.rejected",
+    "router.deadline_exceeded",
+    "router.cancelled",
+    "router.preempt_cap",
+    "router.prefill_failed",
+    # trainer
+    "train.nan_skips",
+    # data paths (the webdata.* names data.* events carry; DESIGN.md §8)
+    "webdata.decode_errors",
+    "webdata.shard_open_retries",
+    "webdata.shards_quarantined",
+    "webdata.shards_opened",
+    "webdata.quarantined_skips",
+    "webdata.shard_aborts",
+    "download.retries",
+    "download.failures",
+    # the telemetry layer's self-accounting
+    "telemetry.dropped",
+    "telemetry.sink_errors",
+})
+
+# -------------------------------------------------------------- gauges
+
+GAUGES = frozenset({
+    "serve.pool_occupancy",
+    "serve.running",
+    "serve.prefilling",
+    "serve.queued",
+    "router.queued",
+    "router.fleet_occupancy",
+    "router.replicas_live",
+    "router.replica_state_code",
+})
+
+# ---------------------------------------------------------- histograms
+
+HISTOGRAMS = frozenset({
+    "serve.queue_wait_s",
+    "serve.ttft_s",
+    "serve.request_latency_s",
+    "serve.completed_latency_s",
+    "router.failover_latency_s",
+})
+
+# span durations are auto-observed as "<span>_s" (utils/telemetry.py);
+# derived here so readers (bench latency splits) can validate against it
+SPAN_DURATION_HISTOGRAMS = frozenset(s + "_s" for s in SPANS)
+
+ALL_NAMES = SPANS | EVENTS | COUNTERS | GAUGES | HISTOGRAMS
+
+_KINDS = {
+    "span": SPANS,
+    "event": EVENTS,
+    "counter": COUNTERS,
+    "gauge": GAUGES,
+    "histogram": HISTOGRAMS | SPAN_DURATION_HISTOGRAMS,
+}
+
+
+def is_registered(name: str, kind: str = None) -> bool:
+    """True iff ``name`` is registered (optionally under ``kind`` in
+    span/event/counter/gauge/histogram)."""
+    if kind is None:
+        return name in ALL_NAMES or name in SPAN_DURATION_HISTOGRAMS
+    return name in _KINDS[kind]

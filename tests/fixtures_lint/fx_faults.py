@@ -1,0 +1,24 @@
+"""Known-bad fault-site fixture: take-sites against the miniature
+registry (fx_faults_registry.py). AST-parsed only."""
+
+
+class _FakeFaults:
+    def take(self, site):
+        return False
+
+    def maybe_raise(self, site, exc):
+        pass
+
+
+FAULTS = _FakeFaults()
+
+
+def production_path():
+    if FAULTS.take("used_site"):            # clean
+        return "boom"
+    if FAULTS.take("undrilled_site"):       # clean here; DTL033 at registry
+        return "boom"
+    if FAULTS.take("typo_site"):            # line 21: DTL031 (unregistered)
+        return "boom"
+    FAULTS.maybe_raise("typo_site_2", OSError())   # line 23: DTL031
+    return "ok"

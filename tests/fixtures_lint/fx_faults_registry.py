@@ -1,0 +1,10 @@
+"""Miniature fault registry for the fault-site checker fixtures.
+AST-parsed only."""
+
+KNOWN_SITES = frozenset({
+    "used_site",       # taken + exercised: clean
+    "dead_site",       # exercised but never taken: DTL032
+    "undrilled_site",  # taken but never exercised: DTL033
+})
+
+_VALUE_SITES = frozenset()

@@ -1,0 +1,13 @@
+"""Exercise corpus for the fault-site fixture: drills that arm
+used_site (programmatic) and dead_site (env-spec fragment).
+
+undrilled_site=1 — this docstring MENTIONS a drill spec, and that must
+NOT count: documentation of a drill is not a drill (docstrings are
+excluded from the exercise corpus), so undrilled_site still raises
+DTL033."""
+
+SPEC = "dead_site=1"
+
+
+def drill(faults):
+    faults.arm("used_site", 2)

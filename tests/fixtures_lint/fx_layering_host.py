@@ -1,0 +1,10 @@
+"""Known-bad layering fixture: a 'host-only' module importing the jax
+stack, top-level and lazily. AST-parsed only, never imported."""
+
+import jax                     # line 4: DTL021
+
+
+def lazy_offender():
+    import flax                # line 8: DTL021 (function-level counts too)
+
+    return flax, jax

@@ -1,0 +1,10 @@
+"""Known-bad layering fixture: an 'ops-layer' module reaching up into
+serving. AST-parsed only, never imported."""
+
+from dalle_pytorch_tpu.serving import engine           # line 4: DTL021
+from dalle_pytorch_tpu.serving.types import Request    # line 5: DTL021
+# the from-parent spelling must be caught too (the module lands in the
+# alias list, not in node.module):
+from dalle_pytorch_tpu import serving as srv           # line 8: DTL021
+
+__all__ = ["engine", "Request", "srv"]

@@ -1,0 +1,49 @@
+"""Known-bad lock-discipline fixture. AST-parsed only."""
+
+import threading
+
+
+class Guarded:
+    _GUARDED_BY = {"_lock": ("_items", "count")}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self.count = 0          # __init__ exempt: object not shared yet
+
+    def ok(self):
+        with self._lock:
+            self._items.append(1)
+            self.count += 1
+
+    def ok_nested_lambda(self):
+        with self._lock:
+            return sorted(self._items, key=lambda x: x + self.count)
+
+    def bad_write(self):
+        self._items.append(1)   # line 24: DTL051
+
+    def bad_read(self):
+        return self.count       # line 27: DTL051 (torn reads count too)
+
+    def _bump_locked(self):
+        self.count += 1         # *_locked convention: caller holds lock
+
+    def suppressed_read(self):
+        return self.count  # dtl: disable=DTL051
+
+
+class MalformedTable:
+    _GUARDED_BY = [("_lock", ("_items",))]   # line 37: DTL051 — not a dict
+
+    def __init__(self):
+        self._items = []
+
+
+class TypoField:
+    _GUARDED_BY = {"_lock": ("_queu",)}      # typo: __init__ sets _queue —
+                                             # line 43: DTL051
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []

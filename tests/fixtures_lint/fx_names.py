@@ -1,0 +1,16 @@
+"""Known-bad telemetry-name fixture against fx_names_registry.py.
+AST-parsed only."""
+
+counters = gauges = histograms = TELEMETRY = None  # parsed, never run
+
+
+def emit(reason):
+    counters.inc("fx.known")                       # clean
+    counters.inc("fx.typo")                        # line 9: DTL041
+    gauges.set("fx.known", 1.0)                    # line 10: DTL041 (kind)
+    histograms.observe("fx.wait_s", 0.1)           # clean
+    histograms.observe("fx.request_s", 0.1)        # clean: span duration
+    TELEMETRY.event("fx.evt", detail=1)            # clean
+    TELEMETRY.span("fx.request")                   # clean
+    counters.inc(f"fx.reasons.{reason}")           # clean: head matches
+    counters.inc(f"fx.bogus.{reason}")             # line 16: DTL041 (head)

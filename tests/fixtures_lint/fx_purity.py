@@ -1,0 +1,78 @@
+"""Known-bad jit-purity fixture (tests/test_static_analysis.py).
+
+NEVER imported — AST-parsed only. Each violation's line number is pinned
+by the test, so keep edits append-only or fix the test's expectations.
+"""
+
+import time
+
+import jax
+import numpy as np
+from functools import partial
+
+HISTORY = []          # mutable module global (DTL014 bait)
+LIMIT = 4             # immutable global: never flagged
+
+
+@jax.jit
+def bad_branch(x):
+    if x > 0:                        # line 19: DTL011
+        return -x
+    return x
+
+
+@partial(jax.jit, static_argnums=(1,))
+def bad_sync(x, n):
+    if n > 2:                        # static arg: NOT a finding
+        x = x + 1
+    y = x * LIMIT
+    v = float(y)                     # line 29: DTL012 (propagated taint)
+    w = x.item()                     # line 30: DTL012
+    return x + v + w
+
+
+@jax.jit
+def bad_clock(x):
+    t = time.time()                  # line 36: DTL013
+    return x + t + len(HISTORY)      # line 37: DTL014
+
+
+def _helper(y):
+    return y * np.random.rand()      # line 41: DTL013 (reached from jit)
+
+
+@jax.jit
+def reaches_impure(x):
+    return _helper(x)
+
+
+@jax.jit
+def structure_check(x, mask=None):
+    if mask is None:                 # is-None: NOT a finding
+        return x
+    return x * mask
+
+
+@jax.jit
+def suppressed_branch(x):
+    # legit-looking dynamic branch a reviewer accepted with a reason:
+    if x.sum() > 0:  # dtl: disable=DTL011
+        return x
+    return -x
+
+
+@jax.jit
+def baselined_loop(x):
+    while x > 0:                     # line 66: DTL011 — grandfathered in
+        x = x - 1                    # fx_baseline.json, not fixed yet
+    return x
+
+
+@jax.jit
+def twin_branches(x):
+    if x > 0:                        # line 73: DTL011, anchor ...:If
+        x = x + 1
+    if x < 0:                        # line 75: DTL011, anchor ...:If#2 —
+        x = x - 1                    # colliding anchors get occurrence
+    return x                         # suffixes so a baseline entry can
+                                     # only ever excuse ONE violation

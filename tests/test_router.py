@@ -347,7 +347,7 @@ class TestFailover:
             router.step()
         holder = router._replicas[1]
         assert "victim" in holder.inflight
-        router._kill(holder, "crash")
+        router.kill(holder.id, "crash")
         # no healthy replica: the requeued request waits at the router
         # while the shared clock keeps advancing past its deadline
         router.run(max_steps=300)
@@ -375,7 +375,7 @@ class TestFailover:
             assert router.submit(req(i)) is None
         for _ in range(2):
             router.step()
-        router._kill(router._replicas[0], "crash")
+        router.kill(0, "crash")
         router.run(max_steps=50)
         outcomes = accounting_holds(router)
         assert outcomes["rejected"] == 2

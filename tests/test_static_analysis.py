@@ -1,0 +1,343 @@
+"""dalle-tpu-lint framework tests (tools/lint/, docs/DESIGN.md §11).
+
+Two layers:
+
+1. **Fixture corpus** (tests/fixtures_lint/): known-bad snippets, AST-
+   parsed only (never imported), with exact finding codes AND lines
+   pinned per checker — each one a violation the checker would have
+   caught at review time that runtime tests would miss. Includes one
+   inline-suppressed case and one baselined case, pinning both escape
+   hatches.
+2. **The repo gate**: ``python tools/lint.py --check`` over the whole
+   package must exit 0 — the same pre-flight tools/serve_smoke.py and
+   tools/telemetry_smoke.py run. A lint finding anywhere in the tree
+   fails the fast tier here, not at the next release drill.
+
+The linter is stdlib-only and never imports the package it checks, so
+everything here runs in milliseconds with no jax involvement.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from lint import (  # noqa: E402  (tools/lint package, stdlib-only)
+    FaultConfig,
+    LayerRule,
+    LintConfig,
+    NamesConfig,
+    default_config,
+    run_lint,
+)
+
+FX = "tests/fixtures_lint"
+
+
+def fixture_config(**kw) -> LintConfig:
+    base = dict(
+        repo_root=str(REPO),
+        scan_roots=(),
+        exclude=(),
+        layer_rules=(),
+        faults=None,
+        names=None,
+        baseline_path=None,
+    )
+    base.update(kw)
+    return LintConfig(**base)
+
+
+def codes_lines(findings):
+    return sorted((f.code, f.line) for f in findings)
+
+
+# ------------------------------------------------------------- purity
+
+
+class TestPurity:
+    def run(self, baseline=None):
+        cfg = fixture_config(baseline_path=baseline)
+        return run_lint(cfg, paths=[f"{FX}/fx_purity.py"],
+                        checkers=["purity"])
+
+    def test_exact_codes_and_lines(self):
+        res = self.run()
+        assert codes_lines(res.findings) == [
+            ("DTL011", 19),   # if on traced value
+            ("DTL011", 66),   # while on traced value (baselined case, no
+                              # baseline loaded in this run)
+            ("DTL011", 73),   # twin branch 1
+            ("DTL011", 75),   # twin branch 2
+            ("DTL012", 29),   # float() on propagated taint
+            ("DTL012", 30),   # .item()
+            ("DTL013", 36),   # time.time() in the jitted fn
+            ("DTL013", 41),   # np.random reached from a jitted fn
+            ("DTL014", 37),   # mutable module-global closure
+        ], [f.render() for f in res.findings]
+
+    def test_colliding_anchors_get_occurrence_suffixes(self):
+        """Two same-shape violations in one function must carry DISTINCT
+        baseline keys — otherwise one baseline entry would silently
+        grandfather every future violation of that shape there."""
+        res = self.run()
+        keys = sorted(f.key for f in res.findings
+                      if "twin_branches" in f.anchor)
+        assert keys == [
+            f"{FX}/fx_purity.py::DTL011::twin_branches:If",
+            f"{FX}/fx_purity.py::DTL011::twin_branches:If#2",
+        ]
+
+    def test_static_args_and_none_checks_are_clean(self):
+        res = self.run()
+        lines = {f.line for f in res.findings}
+        assert 26 not in lines   # `if n > 2` — n is static_argnums
+        assert 51 not in lines   # `if mask is None` — structure check
+
+    def test_inline_suppression(self):
+        res = self.run()
+        sup = [f for f in res.suppressed]
+        assert [(f.code, f.line) for f in sup] == [("DTL011", 59)]
+        assert not any(f.line == 59 for f in res.findings)
+
+    def test_baseline_grandfathers_and_reports_stale(self):
+        res = self.run(baseline=f"{FX}/fx_baseline.json")
+        assert ("DTL011", 66) not in codes_lines(res.findings)
+        assert [(f.code, f.line) for f in res.baselined] == [("DTL011", 66)]
+        assert res.stale_baseline == []
+
+
+# ----------------------------------------------------------- layering
+
+
+class TestLayering:
+    def test_host_only_rule_flags_lazy_imports_too(self):
+        cfg = fixture_config(layer_rules=(
+            LayerRule(name="fx-host-only",
+                      files=(f"{FX}/fx_layering_host.py",),
+                      forbid=("jax", "flax"), why="fixture"),
+        ))
+        res = run_lint(cfg, paths=[f"{FX}/fx_layering_host.py"],
+                       checkers=["layering"])
+        assert codes_lines(res.findings) == [
+            ("DTL021", 4), ("DTL021", 8),
+        ], [f.render() for f in res.findings]
+
+    def test_ops_must_not_import_serving(self):
+        cfg = fixture_config(layer_rules=(
+            LayerRule(name="fx-ops",
+                      files=(f"{FX}/fx_layering_ops.py",),
+                      forbid=("dalle_pytorch_tpu.serving",), why="fixture"),
+        ))
+        res = run_lint(cfg, paths=[f"{FX}/fx_layering_ops.py"],
+                       checkers=["layering"])
+        assert codes_lines(res.findings) == [
+            ("DTL021", 4),   # from x.serving import engine
+            ("DTL021", 5),   # from x.serving.types import Request
+            ("DTL021", 8),   # from x import serving — the from-parent
+                             # spelling lands in the alias list
+        ], [f.render() for f in res.findings]
+
+    def test_relative_imports_resolve_against_package(self):
+        # the REAL repo rule: utils/telemetry.py's `from .faults import`
+        # resolves to dalle_pytorch_tpu.utils.faults and must NOT trip
+        # the host-only rule, while any jax import would
+        res = run_lint(default_config(str(REPO)),
+                       paths=["dalle_pytorch_tpu/utils/telemetry.py"],
+                       checkers=["layering"])
+        assert res.clean, [f.render() for f in res.findings]
+
+
+# -------------------------------------------------------- fault sites
+
+
+class TestFaultSites:
+    def run(self):
+        cfg = fixture_config(faults=FaultConfig(
+            registry_path=f"{FX}/fx_faults_registry.py",
+            exercise_roots=(f"{FX}/fx_faults_tests.py",),
+        ))
+        return run_lint(cfg, paths=[f"{FX}/fx_faults.py"],
+                        checkers=["fault-sites"], full=True)
+
+    def test_unknown_dead_and_undrilled_sites(self):
+        res = self.run()
+        by_code = {}
+        for f in res.findings:
+            by_code.setdefault(f.code, []).append(f)
+        # two unregistered literals at their exact take-site lines
+        assert [(f.line, f.anchor) for f in by_code["DTL031"]] == [
+            (21, "typo_site"), (23, "typo_site_2"),
+        ]
+        # dead_site is registered + drilled but never taken
+        assert [f.anchor for f in by_code["DTL032"]] == ["dead_site"]
+        # undrilled_site is registered + taken but never exercised —
+        # the corpus docstring MENTIONING "undrilled_site=1" does not
+        # count (documentation of a drill is not a drill)
+        assert [f.anchor for f in by_code["DTL033"]] == ["undrilled_site"]
+
+    def test_narrowed_scan_skips_registry_completeness(self):
+        cfg = fixture_config(faults=FaultConfig(
+            registry_path=f"{FX}/fx_faults_registry.py",
+            exercise_roots=(f"{FX}/fx_faults_tests.py",),
+        ))
+        res = run_lint(cfg, paths=[f"{FX}/fx_faults.py"],
+                       checkers=["fault-sites"])  # full defaults to False
+        assert {f.code for f in res.findings} == {"DTL031"}
+
+
+# ----------------------------------------------------- telemetry names
+
+
+class TestTelemetryNames:
+    def run(self, full=True):
+        cfg = fixture_config(names=NamesConfig(
+            registry_path=f"{FX}/fx_names_registry.py",
+            doc_path=f"{FX}/fx_names_doc.md",
+        ))
+        return run_lint(cfg, paths=[f"{FX}/fx_names.py"],
+                        checkers=["telemetry-names"], full=full)
+
+    def test_typo_kind_mismatch_and_bad_fstring_head(self):
+        res = self.run(full=False)
+        assert codes_lines(res.findings) == [
+            ("DTL041", 9),    # fx.typo: unregistered
+            ("DTL041", 10),   # fx.known used as gauge: kind mismatch
+            ("DTL041", 16),   # f"fx.bogus.{...}": head matches nothing
+        ], [f.render() for f in res.findings]
+
+    def test_span_duration_histograms_are_derived(self):
+        res = self.run(full=False)
+        assert 12 not in {f.line for f in res.findings}  # fx.request_s ok
+
+    def test_doc_crosscheck(self):
+        res = self.run(full=True)
+        dtl042 = [f for f in res.findings if f.code == "DTL042"]
+        # fx.wait pins whole-token doc matching: it PREFIXES the
+        # documented `fx.wait_s` and must still count as undocumented
+        assert [f.anchor for f in dtl042] == ["fx.undocumented", "fx.wait"]
+
+
+# ------------------------------------------------------------- locks
+
+
+class TestLocks:
+    def run(self):
+        return run_lint(fixture_config(), paths=[f"{FX}/fx_locks.py"],
+                        checkers=["locks"])
+
+    def test_unguarded_read_and_write(self):
+        res = self.run()
+        assert codes_lines(res.findings) == [
+            ("DTL051", 24),   # write outside the lock
+            ("DTL051", 27),   # torn read outside the lock
+            ("DTL051", 37),   # malformed table fails LOUD, not silent
+            ("DTL051", 43),   # typo'd guarded field __init__ never sets
+        ], [f.render() for f in res.findings]
+
+    def test_exemptions(self):
+        res = self.run()
+        lines = {f.line for f in res.findings}
+        assert 11 not in lines and 12 not in lines  # __init__ exempt
+        assert 30 not in lines                      # *_locked convention
+        assert 21 not in lines                      # locked lambda is fine
+        assert [(f.code, f.line) for f in res.suppressed] == [
+            ("DTL051", 33),
+        ]
+
+
+# ---------------------------------------------------- repo-level gates
+
+
+class TestRepoGate:
+    def test_lint_check_exits_zero_on_the_repo(self):
+        """THE acceptance gate: the whole package is finding-free (or
+        explicitly baselined) under all five checkers."""
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"), "--check"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, (
+            f"lint --check failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+
+    def test_json_mode_emits_parseable_findings(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"), "--json",
+             f"{FX}/fx_locks.py"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0  # report mode never gates
+        recs = [json.loads(line) for line in proc.stdout.splitlines()]
+        assert {r["code"] for r in recs} == {"DTL051"}
+        assert all(r["key"].startswith(f"{FX}/fx_locks.py::") for r in recs)
+
+    def test_check_mode_fails_on_findings(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"), "--check",
+             f"{FX}/fx_locks.py"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 1
+        assert "DTL051" in proc.stdout
+
+    def test_check_mode_fails_on_stale_baseline(self, tmp_path):
+        """The baseline can only shrink: a key whose finding was fixed
+        fails the full-scan gate until it is pruned (a lingering dead
+        key could mask a future same-shape violation)."""
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps([
+            {"key": "gone/file.py::DTL011::fixed_long_ago:If",
+             "note": "stale"},
+        ]))
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"), "--check",
+             "--baseline", str(bl)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 1, proc.stderr
+        assert "stale baseline entry" in proc.stderr
+
+    def test_guarded_by_tables_are_declared(self):
+        """The seeded lock-discipline contracts exist where PR 6's
+        thread-safety lives: Router, the metrics registries, the
+        telemetry ring."""
+        import ast
+
+        want = {
+            "dalle_pytorch_tpu/serving/router.py": {"Router"},
+            "dalle_pytorch_tpu/utils/metrics.py": {
+                "Counters", "Gauges", "Histograms", "Histogram",
+            },
+            "dalle_pytorch_tpu/utils/telemetry.py": {"Telemetry"},
+        }
+        for path, classes in want.items():
+            tree = ast.parse((REPO / path).read_text())
+            declared = {
+                cls.name
+                for cls in ast.walk(tree) if isinstance(cls, ast.ClassDef)
+                if any(
+                    isinstance(n, ast.Assign) and any(
+                        isinstance(t, ast.Name) and t.id == "_GUARDED_BY"
+                        for t in n.targets
+                    )
+                    for n in cls.body
+                )
+            }
+            assert classes <= declared, (path, declared)
+
+    def test_fault_registry_is_one_to_one(self):
+        """Every KNOWN_SITES entry has a production take-site and a
+        test/tool drill — the cross-reference the checker enforces
+        (finding nothing IS the assertion)."""
+        res = run_lint(default_config(str(REPO)),
+                       checkers=["fault-sites"])
+        assert res.clean, [f.render() for f in res.findings]
+
+    def test_telemetry_names_match_registry_and_docs(self):
+        res = run_lint(default_config(str(REPO)),
+                       checkers=["telemetry-names"])
+        assert res.clean, [f.render() for f in res.findings]

@@ -311,32 +311,32 @@ class TestExposition:
 
 def test_telemetry_is_host_side_only():
     """The span path must never touch the device: a per-token sync would
-    be a measurement that destroys what it measures. Enforced at the
-    import level — the module has no jax/jnp imports at all (everything
-    it records is a plain Python number handed in by callers)."""
-    import ast
-    import inspect
+    be a measurement that destroys what it measures. Enforced by the
+    import-layering checker (tools/lint.py DTL021, rule
+    'host-only-utils' — docs/DESIGN.md §11), which checks every import
+    node including lazy function-level ones and covers the whole
+    host-side layer (telemetry, metrics, faults, resilience), not just
+    the two modules the old source-grep pinned. This test is the thin
+    gate: the checker must find NOTHING there."""
+    import sys
+    from pathlib import Path
 
-    import dalle_pytorch_tpu.utils.telemetry as telemetry
+    repo = Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(repo / "tools"))
+    from lint import default_config, run_lint
 
-    tree = ast.parse(inspect.getsource(telemetry))
-    imported = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            imported |= {a.name.split(".")[0] for a in node.names}
-        elif isinstance(node, ast.ImportFrom) and node.level == 0:
-            imported.add((node.module or "").split(".")[0])
-    assert "jax" not in imported and "jaxlib" not in imported, imported
-    # and its metrics dependency is host-side too
-    import dalle_pytorch_tpu.utils.metrics as metrics
-
-    tree = ast.parse(inspect.getsource(metrics))
-    top_level_imports = {
-        a.name.split(".")[0]
-        for node in tree.body if isinstance(node, ast.Import)
-        for a in node.names
-    }
-    assert "jax" not in top_level_imports, top_level_imports
+    res = run_lint(
+        default_config(str(repo)),
+        paths=[
+            "dalle_pytorch_tpu/utils/telemetry.py",
+            "dalle_pytorch_tpu/utils/telemetry_names.py",
+            "dalle_pytorch_tpu/utils/metrics.py",
+            "dalle_pytorch_tpu/utils/faults.py",
+            "dalle_pytorch_tpu/utils/resilience.py",
+        ],
+        checkers=["layering"],
+    )
+    assert res.clean, [f.render() for f in res.findings]
 
 
 # ------------------------------------------------- engine span chains

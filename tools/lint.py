@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""dalle-tpu-lint CLI: AST-based invariant checks for this repo.
+
+Usage::
+
+    python tools/lint.py [--json] [--check] [--checks a,b,...] [paths...]
+
+* no flags: report findings (human-readable), always exit 0;
+* ``--check``: exit 1 when any non-suppressed, non-baselined finding
+  survives — the release-gate / CI mode (tools/serve_smoke.py and
+  tools/telemetry_smoke.py run this as their pre-flight);
+* ``--json``: one JSON object per finding on stdout;
+* ``--checks``: comma list from {purity, layering, fault-sites,
+  telemetry-names, locks} (default: all);
+* ``paths``: repo-relative files/dirs to scan (default: the package +
+  CLI entrypoints — see tools/lint/config.py).
+
+Finding codes, the suppression comment (``# dtl: disable=DTL0xx``), and
+the baseline policy (tools/lint_baseline.json) are documented in
+docs/DESIGN.md §11 and tools/lint/__init__.py. The linter is stdlib-only
+and never imports the package it checks — it runs in milliseconds with
+no jax in sight.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(_TOOLS_DIR)
+# the tools/lint/ package shadows this script on sys.path (regular
+# packages win over same-named modules in the same directory)
+sys.path.insert(0, _TOOLS_DIR)
+
+from lint import default_config, run_lint  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/lint.py",
+        description="dalle-tpu-lint: AST-based invariant checks",
+    )
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON lines")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any live finding (gate mode)")
+    ap.add_argument("--checks", default=None,
+                    help="comma list of checkers to run (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help="override the baseline file "
+                         "(default: tools/lint_baseline.json)")
+    ap.add_argument("paths", nargs="*",
+                    help="repo-relative files/dirs (default: scan roots)")
+    args = ap.parse_args(argv)
+
+    config = default_config(_REPO_ROOT)
+    if args.baseline is not None:
+        import dataclasses
+
+        config = dataclasses.replace(config, baseline_path=args.baseline)
+    checkers = (
+        [c.strip() for c in args.checks.split(",") if c.strip()]
+        if args.checks else None
+    )
+    try:
+        result = run_lint(config, paths=args.paths or None, checkers=checkers)
+    except (ValueError, OSError, SyntaxError) as e:
+        print(f"lint: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        for f in result.findings:
+            print(json.dumps(f.to_json()))
+    else:
+        for f in result.findings:
+            print(f.render())
+    n = len(result.findings)
+    summary = (
+        f"lint: {n} finding{'s' if n != 1 else ''} "
+        f"({len(result.suppressed)} suppressed, "
+        f"{len(result.baselined)} baselined)"
+    )
+    print(summary, file=sys.stderr)
+    for key in result.stale_baseline:
+        # a stale entry means the finding it excused is gone: prune it
+        print(f"lint: stale baseline entry {key} — remove it from the "
+              f"baseline file", file=sys.stderr)
+    if args.check and (result.findings or result.stale_baseline):
+        # stale entries FAIL the gate too: the baseline can only shrink,
+        # and a dead key must not linger to mask a future same-shape
+        # violation
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""dalle-tpu-lint: AST-based invariant checker for this repository.
+
+Six PRs in, the codebase's correctness rests on invariants that were
+enforced only by convention or by one-off runtime checks: "telemetry is
+host-side only" was a single source-grep test, fault-site names were
+validated only when ``DALLE_TPU_FAULTS`` was parsed at runtime,
+telemetry names were bare string literals scattered across the engine/
+router/train paths, and the thread-safety of the replicated front door
+depended on every future edit remembering which fields which lock
+guards. This package makes those invariants machine-checked at review
+time — before a single test runs — in the same spirit as the paper's
+"static shapes everywhere" thesis (docs/DESIGN.md §1): the rules are
+*data* (a layer map, a name registry, a ``_GUARDED_BY`` table), and one
+small framework interprets them.
+
+Five checkers, one finding-code block each (docs/DESIGN.md §11):
+
+=========  ==================================================================
+DTL011     jit purity: Python ``if``/``while`` on a traced value inside a
+           ``jax.jit``/``pjit``/``shard_map``-wrapped function (retrace /
+           trace-error hazard; ``is None`` structure checks are exempt)
+DTL012     jit purity: host sync on a traced value (``.item()``,
+           ``float()/int()/bool()``, ``np.asarray``/``np.array``)
+DTL013     jit impurity: wall-clock / stdlib-RNG call inside jit-reachable
+           code (``time.*``, ``random.*``, ``np.random.*`` — the value is
+           frozen at trace time, a silent staleness bug)
+DTL014     jit purity: closure over a mutable module-level container
+           (list/dict/set global read inside a jitted function — already-
+           cached traces ignore later mutation)
+DTL021     import layering: a module imported something its declared layer
+           forbids (host-side utils must be jax-free; ops must not import
+           serving; library code must not import the CLI entrypoints)
+DTL031     fault sites: a fault-registry call names a site that is not in
+           ``KNOWN_SITES`` (would silently inject nothing)
+DTL032     fault sites: a ``KNOWN_SITES`` entry has no take-site in the
+           package (dead registry entry)
+DTL033     fault sites: a ``KNOWN_SITES`` entry is never exercised by any
+           test or tool (a drill nobody runs)
+DTL041     telemetry names: a counter/gauge/histogram/span/event literal is
+           not in the registry (``utils/telemetry_names.py``), or is
+           registered under a different kind
+DTL042     telemetry names: a registry entry is absent from the
+           docs/DESIGN.md §9 name tables
+DTL051     lock discipline: a field declared in a class's ``_GUARDED_BY``
+           table is read/written outside a ``with self.<lock>`` block
+           (``__init__`` and ``*_locked`` callee-convention methods exempt)
+=========  ==================================================================
+
+Suppression: append ``# dtl: disable=DTL0xx[,DTL0yy]`` to the finding's
+line. Grandfathering: add the finding's stable key to the committed
+baseline (``tools/lint_baseline.json``) with a justification note —
+``--check`` ignores baselined findings but reports stale entries.
+
+Stdlib-``ast`` only, no third-party deps, never imports the package it
+lints (so it runs in milliseconds, jax-free, anywhere).
+"""
+
+from __future__ import annotations
+
+from .core import Finding, LintResult, SourceFile, load_files, run_lint
+from .config import (
+    FaultConfig,
+    LayerRule,
+    LintConfig,
+    NamesConfig,
+    default_config,
+)
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "SourceFile",
+    "LintConfig",
+    "LayerRule",
+    "FaultConfig",
+    "NamesConfig",
+    "default_config",
+    "load_files",
+    "run_lint",
+]

@@ -1,0 +1,329 @@
+"""Framework core: findings, suppressions, baseline, the runner.
+
+A *finding* is (code, path, line, message, anchor). The anchor is the
+checker-chosen stable identity component (a telemetry name, a fault
+site, ``ClassName.field``, ``function:construct``) so the baseline key
+``path::code::anchor`` survives unrelated edits that shift line numbers
+— the property a committed baseline needs to not rot.
+
+Suppression is line-scoped and explicit: ``# dtl: disable=DTL011`` (or a
+comma list) on the finding's line. There is deliberately no file-scoped
+or next-line form — a suppression should sit on the construct it
+excuses, where a reviewer sees both together.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*dtl:\s*disable=([A-Z0-9,\s]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    code: str
+    path: str      # repo-relative posix path
+    line: int
+    message: str
+    anchor: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.code}::{self.anchor}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "code": self.code, "path": self.path, "line": self.line,
+            "message": self.message, "key": self.key,
+        }
+
+
+class SourceFile:
+    """One parsed module plus its suppression map."""
+
+    def __init__(self, path: str, abspath: str, source: str):
+        self.path = path
+        self.abspath = abspath
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self._suppress: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self._suppress[i] = {
+                    c.strip() for c in m.group(1).split(",") if c.strip()
+                }
+
+    def suppressed(self, line: int, code: str) -> bool:
+        return code in self._suppress.get(line, ())
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]            # live (reported) findings
+    suppressed: List[Finding]          # silenced by inline comments
+    baselined: List[Finding]           # silenced by the baseline file
+    stale_baseline: List[str]          # baseline keys that matched nothing
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+
+def load_files(repo_root: str, roots: Sequence[str],
+               exclude: Sequence[str] = ()) -> List[SourceFile]:
+    """Load and parse every .py file under ``roots`` (repo-relative files
+    or directories), skipping ``exclude`` fnmatch patterns. Unparseable
+    files raise — a syntax error is itself a broken tree."""
+    out: List[SourceFile] = []
+    seen: Set[str] = set()
+    for root in roots:
+        ab_root = root if os.path.isabs(root) else os.path.join(repo_root, root)
+        ab_root = os.path.abspath(ab_root)
+        if os.path.isfile(ab_root):
+            # an explicitly named file is always scanned — exclude
+            # patterns only prune directory walks (they keep fixture
+            # corpora out of the DEFAULT roots, not out of a direct ask)
+            pairs = [(os.path.relpath(ab_root, repo_root), ab_root)]
+            walked = False
+        else:
+            pairs = []
+            walked = True
+            for dirpath, dirnames, filenames in os.walk(ab_root):
+                dirnames[:] = [
+                    d for d in sorted(dirnames) if d != "__pycache__"
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        ab = os.path.join(dirpath, fn)
+                        pairs.append((os.path.relpath(ab, repo_root), ab))
+        for rel, ab in pairs:
+            rel = rel.replace(os.sep, "/")
+            if rel in seen:
+                continue
+            if walked and any(fnmatch.fnmatch(rel, pat) for pat in exclude):
+                continue
+            seen.add(rel)
+            with open(ab, encoding="utf-8") as f:
+                src = f.read()
+            out.append(SourceFile(rel, ab, src))
+    return out
+
+
+def load_baseline(path: Optional[str]) -> Dict[str, str]:
+    """``{key: note}`` from the committed baseline JSON. The file is a
+    list of ``{"key": ..., "note": ...}`` objects — every grandfathered
+    finding must say WHY it is grandfathered."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)  # JSONDecodeError is a ValueError: CLI exit 2
+    if not isinstance(data, list):
+        raise ValueError(
+            f"baseline {path}: want a JSON list of "
+            f'{{"key": ..., "note": ...}} objects, got {type(data).__name__}'
+        )
+    out: Dict[str, str] = {}
+    for i, entry in enumerate(data):
+        if not isinstance(entry, dict) or "key" not in entry:
+            raise ValueError(
+                f"baseline {path}: entry {i} must be an object with a "
+                f'"key" (and a justifying "note"), got {entry!r}'
+            )
+        out[entry["key"]] = entry.get("note", "")
+    return out
+
+
+def run_lint(config, paths: Optional[Sequence[str]] = None,
+             checkers: Optional[Sequence[str]] = None,
+             full: Optional[bool] = None) -> LintResult:
+    """Run the selected checkers (default: all configured) over ``paths``
+    (default: the config's scan roots) and fold in suppressions and the
+    baseline. ``full`` controls the registry-completeness directions
+    (DTL032/033/042) — default: on exactly when scanning the full
+    roots; fixture tests scanning explicit paths against their own
+    miniature registries pass ``full=True``."""
+    from . import fault_sites, layering, locks, names, purity
+
+    registry = {
+        "purity": purity.check,
+        "layering": layering.check,
+        "fault-sites": fault_sites.check,
+        "telemetry-names": names.check,
+        "locks": locks.check,
+    }
+    if checkers is None:
+        selected = list(registry)
+        if config.faults is None:
+            selected.remove("fault-sites")
+        if config.names is None:
+            selected.remove("telemetry-names")
+    else:
+        unknown = set(checkers) - set(registry)
+        if unknown:
+            raise ValueError(
+                f"unknown checkers {sorted(unknown)} "
+                f"(known: {sorted(registry)})"
+            )
+        selected = list(checkers)
+
+    # registry-completeness directions (dead fault sites, undocumented
+    # registry names) are only meaningful over the full scan roots: a
+    # narrowed path list would make every unseen use look "dead"
+    if full is None:
+        full = paths is None
+    files = load_files(
+        config.repo_root, paths or config.scan_roots, config.exclude
+    )
+    raw: List[Finding] = []
+    for name in selected:
+        raw.extend(registry[name](files, config, full=full))
+    raw.sort(key=lambda f: (f.path, f.line, f.code, f.anchor))
+    # Uniquify colliding keys deterministically (source order): two `if`s
+    # on traced values in one function share the anchor `fn:If`, and a
+    # baseline entry must excuse exactly ONE violation, never a class of
+    # them — the Nth same-anchor finding gets `#N`, so adding a new
+    # violation of a baselined shape always surfaces at least one live
+    # finding.
+    occurrences: Dict[str, int] = {}
+    uniq: List[Finding] = []
+    for f in raw:
+        n = occurrences.get(f.key, 0) + 1
+        occurrences[f.key] = n
+        if n > 1:
+            f = Finding(f.code, f.path, f.line, f.message,
+                        f"{f.anchor}#{n}")
+        uniq.append(f)
+    raw = uniq
+
+    by_path = {f.path: f for f in files}
+    baseline = load_baseline(
+        None if config.baseline_path is None
+        else os.path.join(config.repo_root, config.baseline_path)
+    )
+    live: List[Finding] = []
+    suppressed: List[Finding] = []
+    baselined: List[Finding] = []
+    matched_keys: Set[str] = set()
+    for f in raw:
+        sf = by_path.get(f.path)
+        if sf is not None and sf.suppressed(f.line, f.code):
+            suppressed.append(f)
+        elif f.key in baseline:
+            matched_keys.add(f.key)
+            baselined.append(f)
+        else:
+            live.append(f)
+    # staleness is only judgeable over the full scan roots — on a
+    # narrowed path list, entries for unscanned files are merely unseen
+    stale = sorted(set(baseline) - matched_keys) if full else []
+    return LintResult(
+        findings=live, suppressed=suppressed, baselined=baselined,
+        stale_baseline=stale,
+    )
+
+
+# --------------------------------------------------------------- AST utils
+# shared by the checkers; deliberately tiny and permissive — a helper
+# returning None means "could not resolve statically", and checkers skip.
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.random.fold_in`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fstring_prefix(node: ast.AST) -> Optional[str]:
+    """The leading literal text of an f-string (empty string when it
+    starts with an interpolation), None for non-f-strings."""
+    if not isinstance(node, ast.JoinedStr):
+        return None
+    if node.values and isinstance(node.values[0], ast.Constant):
+        v = node.values[0].value
+        if isinstance(v, str):
+            return v
+    return ""
+
+
+def string_fragments(tree: ast.AST) -> Iterable[Tuple[str, int]]:
+    """Every string constant in the tree, f-string literal fragments
+    included, DOCSTRINGS EXCLUDED — the corpus the fault-site exercise
+    check greps. Docstrings don't count: documentation *mentioning* a
+    drill (``DALLE_TPU_FAULTS="x=1" ...`` in a usage example) must not
+    satisfy the cross-reference that the drill actually exists in code."""
+    docstrings = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, "body", [])
+            if (body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                docstrings.add(id(body[0].value))
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and id(node) not in docstrings):
+            yield node.value, getattr(node, "lineno", 0)
+
+
+def parse_frozensets(path: str, names: Sequence[str]) -> Dict[str, Set[str]]:
+    """AST-extract module-level ``NAME = frozenset({...})`` / set-literal
+    string collections — how the linter reads the fault-site and
+    telemetry-name registries without importing the package."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    want = set(names)
+    out: Dict[str, Set[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name) or tgt.id not in want:
+            continue
+        value = node.value
+        if (isinstance(value, ast.Call) and dotted_name(value.func) == "frozenset"
+                and len(value.args) == 1):
+            value = value.args[0]
+        if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+            strings = {
+                s for el in value.elts
+                for s in [str_const(el)] if s is not None
+            }
+            out[tgt.id] = strings
+    return out
+
+
+def assign_lineno(path: str, name: str) -> int:
+    """Line of the module-level assignment to ``name`` (anchor for
+    registry-level findings); 1 when absent."""
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return node.lineno
+    return 1

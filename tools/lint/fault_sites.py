@@ -1,0 +1,141 @@
+"""DTL031-033: fault-site cross-reference.
+
+The fault registry (utils/faults.py) is only as good as its 1:1 mapping
+between registered sites, production take-sites, and the drills that
+exercise them. Runtime validation (the ``DALLE_TPU_FAULTS`` env parser)
+catches a typo'd site only when someone runs that exact drill; this
+checker closes the loop statically:
+
+* **DTL031** — a ``FAULTS.take/maybe_raise/value/arm("...")`` literal
+  that is not in ``KNOWN_SITES``: armed, it would silently inject
+  nothing.
+* **DTL032** — a ``KNOWN_SITES`` entry with no take/maybe_raise/value
+  call in the scanned package: a dead registry entry (the failure it
+  models can no longer be injected anywhere).
+* **DTL033** — a ``KNOWN_SITES`` entry never exercised from the test/
+  tool corpus (``tests/``, ``tools/``): the drill exists but nobody
+  runs it. A site counts as exercised when its exact name — or a
+  ``site=N`` env-spec fragment — appears as a string literal (f-string
+  fragments included, so ``f"nan_at_step={k}"`` in an e2e env counts).
+
+``KNOWN_SITES``/``_VALUE_SITES`` are AST-extracted from the registry
+module, never imported — the linter stays jax-free and instant.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Sequence, Set, Tuple
+
+from .core import (
+    Finding,
+    SourceFile,
+    assign_lineno,
+    load_files,
+    parse_frozensets,
+    str_const,
+    string_fragments,
+)
+
+_TAKE_METHODS = {"take", "maybe_raise", "value"}
+_ARM_METHODS = {"arm"}
+
+
+def _site_calls(sf: SourceFile) -> List[Tuple[str, str, int]]:
+    """(method, site-literal, line) for registry calls with a literal
+    first argument."""
+    out: List[Tuple[str, str, int]] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        if fn.attr not in _TAKE_METHODS | _ARM_METHODS:
+            continue
+        # receiver must look like a fault registry (FAULTS / self.faults /
+        # a FaultRegistry local) — keyed on the conventional names so
+        # dict.get-style lookalikes never match
+        recv = fn.value
+        recv_name = recv.id if isinstance(recv, ast.Name) else (
+            recv.attr if isinstance(recv, ast.Attribute) else ""
+        )
+        if "fault" not in recv_name.lower():
+            continue
+        if not node.args:
+            continue
+        site = str_const(node.args[0])
+        if site is not None:
+            out.append((fn.attr, site, node.lineno))
+    return out
+
+
+def check(files: Sequence[SourceFile], config,
+          full: bool = True) -> List[Finding]:
+    fc = config.faults
+    if fc is None:
+        return []
+    registry_ab = os.path.join(config.repo_root, fc.registry_path)
+    sets = parse_frozensets(registry_ab, ["KNOWN_SITES", "_VALUE_SITES"])
+    known: Set[str] = sets.get("KNOWN_SITES", set())
+    if not known:
+        return [Finding(
+            "DTL031", fc.registry_path, 1,
+            "could not extract KNOWN_SITES from the fault registry",
+            anchor="KNOWN_SITES",
+        )]
+    registry_line = assign_lineno(registry_ab, "KNOWN_SITES")
+
+    findings: List[Finding] = []
+    taken: Dict[str, List[str]] = {}
+    for sf in files:
+        if sf.path == fc.registry_path:
+            continue
+        for method, site, line in _site_calls(sf):
+            if site not in known:
+                findings.append(Finding(
+                    "DTL031", sf.path, line,
+                    f"FAULTS.{method}({site!r}) names an unregistered "
+                    f"fault site (KNOWN_SITES: "
+                    f"{', '.join(sorted(known))})",
+                    anchor=site,
+                ))
+            elif method in _TAKE_METHODS:
+                taken.setdefault(site, []).append(f"{sf.path}:{line}")
+
+    if not full:
+        # the dead-site/undrilled-site directions need the whole package
+        # in view; a narrowed path list would call every unseen site dead
+        return findings
+
+    # exercise corpus: tests/ + tools/ string literals
+    corpus = load_files(config.repo_root, fc.exercise_roots, config.exclude)
+    exercised: Set[str] = set()
+    for sf in corpus:
+        for s, _line in string_fragments(sf.tree):
+            for site in known:
+                if site in exercised:
+                    continue
+                if s == site or (site + "=") in s:
+                    exercised.add(site)
+
+    for site in sorted(known):
+        if site not in taken:
+            findings.append(Finding(
+                "DTL032", fc.registry_path, registry_line,
+                f"KNOWN_SITES entry {site!r} has no "
+                f"take/maybe_raise/value site in the package — dead "
+                f"registry entry (retire it or add the injection point)",
+                anchor=site,
+            ))
+        if site not in exercised:
+            findings.append(Finding(
+                "DTL033", fc.registry_path, registry_line,
+                f"KNOWN_SITES entry {site!r} is never exercised from "
+                f"{'/'.join(fc.exercise_roots)} — add a drill (arm() in a "
+                f"test or a DALLE_TPU_FAULTS spec in a tool) or retire "
+                f"the site",
+                anchor=site,
+            ))
+    return findings

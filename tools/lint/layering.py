@@ -1,0 +1,98 @@
+"""DTL021: declared import layering, checked on real import AST nodes.
+
+Each ``LayerRule`` (tools/lint/config.py) names the files it governs and
+the dotted module prefixes they must not import. Both ``import X`` and
+``from X import Y`` count; relative imports are resolved to absolute
+module paths against the file's package location first, so
+``from ..serving import engine`` inside ``ops/`` is the same violation
+as the absolute spelling. Function-level (lazy) imports are checked too:
+the host-only rules exist precisely because a lazy ``import jax`` in a
+signal handler or loader thread is still a jax import.
+
+This checker replaces (and generalizes) the old source-grep pin in
+tests/test_telemetry.py — the test now simply asserts this checker finds
+nothing in utils/telemetry.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import List, Optional, Sequence
+
+from .core import Finding, SourceFile
+
+
+def _module_package(path: str) -> List[str]:
+    """Package parts for a repo-relative file: ``a/b/c.py`` -> ["a","b"],
+    ``a/b/__init__.py`` -> ["a","b"]."""
+    parts = path.split("/")
+    parts[-1] = parts[-1][:-3]  # strip .py
+    if parts[-1] == "__init__":
+        parts.pop()
+    else:
+        parts.pop()
+    return parts
+
+
+def _resolve_relative(path: str, level: int, module: Optional[str]) -> str:
+    pkg = _module_package(path)
+    base = pkg[: len(pkg) - (level - 1)] if level > 1 else pkg
+    if module:
+        base = base + module.split(".")
+    return ".".join(base)
+
+
+def _forbidden(mod: str, forbid: Sequence[str]) -> Optional[str]:
+    for prefix in forbid:
+        if mod == prefix or mod.startswith(prefix + "."):
+            return prefix
+    return None
+
+
+def check(files: Sequence[SourceFile], config,
+          full: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        rules = [
+            r for r in config.layer_rules
+            if any(
+                fnmatch.fnmatch(sf.path, pat) or sf.path == pat
+                for pat in r.files
+            )
+        ]
+        if not rules:
+            continue
+        for node in ast.walk(sf.tree):
+            targets: List[str] = []
+            if isinstance(node, ast.Import):
+                targets = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level and node.level > 0:
+                    base = _resolve_relative(sf.path, node.level, node.module)
+                else:
+                    base = node.module or ""
+                if base:
+                    targets.append(base)
+                # the from-parent spelling of a submodule import —
+                # `from dalle_pytorch_tpu import serving` / `from .. import
+                # serving` — lands the forbidden module in the ALIASES,
+                # not in node.module; check both
+                targets.extend(
+                    f"{base}.{a.name}" if base else a.name
+                    for a in node.names if a.name != "*"
+                )
+            for rule in rules:
+                # one finding per (import statement, rule), anchored on
+                # the shortest offending module path — `from x.serving
+                # import engine` is one violation, not two
+                hits = [m for m in targets if _forbidden(m, rule.forbid)]
+                if hits:
+                    mod = min(hits, key=len)
+                    findings.append(Finding(
+                        "DTL021", sf.path, node.lineno,
+                        f"imports `{mod}`, forbidden for layer "
+                        f"'{rule.name}' ({rule.why})",
+                        anchor=f"{rule.name}:{mod}",
+                    ))
+    return findings

@@ -1,0 +1,185 @@
+"""DTL051: lock discipline via per-class ``_GUARDED_BY`` tables.
+
+A class declares which of its fields its lock guards::
+
+    class Router:
+        _GUARDED_BY = {"_lock": ("_queue", "results", "_live")}
+
+and this checker enforces, lexically, that every ``self.<field>`` access
+for a guarded field happens inside a ``with self.<lock>:`` block. The
+table is the contract future edits can't silently forget — exactly the
+failure mode of "PR 6's thread-safety depends on remembering which
+fields the lock guards".
+
+Conventions (each one is a reviewed, visible signal at the def site):
+
+* ``__init__`` is exempt — the object is not yet shared.
+* Methods whose name ends in ``_locked`` are exempt — the caller-holds-
+  the-lock convention this codebase already uses (``_drain_locked``).
+  Such methods must only be called with the lock held; giving them the
+  suffix is the declaration.
+* Nested functions/lambdas inherit the lexical lock state of their
+  definition site (a sort key lambda inside a locked region counts as
+  locked; a callback stored for later does not get extra analysis —
+  keep those out of guarded classes).
+* Reads and writes are treated identically: torn reads on a field the
+  table says is guarded are findings too.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding, SourceFile, str_const
+
+
+def _guarded_table(
+    cls: ast.ClassDef,
+) -> Tuple[Optional[Dict[str, Tuple[str, ...]]], Optional[int]]:
+    """(table, None) for a well-formed declaration, (None, None) when the
+    class declares nothing, (None, lineno) for a MALFORMED table — the
+    caller must report that loudly: a table that silently parses to
+    nothing disables exactly the check it exists to declare."""
+    for node in cls.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "_GUARDED_BY"
+            for t in node.targets
+        ):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            return None, node.lineno
+        table: Dict[str, Tuple[str, ...]] = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            lock = str_const(k) if k is not None else None
+            if lock is None:
+                return None, node.lineno
+            if isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+                fields = tuple(
+                    s for el in v.elts for s in [str_const(el)]
+                    if s is not None
+                )
+                if len(fields) != len(v.elts):
+                    return None, node.lineno
+            else:
+                s = str_const(v)
+                if s is None:
+                    return None, node.lineno
+                fields = (s,)
+            table[lock] = fields
+        if not table:
+            return None, node.lineno
+        return table, None
+    return None, None
+
+
+def _init_assigned_attrs(cls: ast.ClassDef) -> Optional[set]:
+    """self.<attr> names assigned anywhere in __init__ (None when the
+    class has no __init__ of its own — inherited init, can't judge)."""
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "__init__":
+            return {
+                n.attr
+                for n in ast.walk(node)
+                if isinstance(n, ast.Attribute)
+                and not isinstance(n.ctx, ast.Load)
+                and isinstance(n.value, ast.Name) and n.value.id == "self"
+            }
+    return None
+
+
+def _is_self_attr(node: ast.AST, attr: str) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == attr
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    )
+
+
+def check(files: Sequence[SourceFile], config,
+          full: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            table, bad_line = _guarded_table(cls)
+            if bad_line is not None:
+                findings.append(Finding(
+                    "DTL051", sf.path, bad_line,
+                    f"{cls.name}._GUARDED_BY is malformed (want a dict "
+                    f"of lock-attr string -> tuple of field-name "
+                    f"strings) — a table that parses to nothing silently "
+                    f"disables the check it declares",
+                    anchor=f"{cls.name}:_GUARDED_BY",
+                ))
+                continue
+            if not table:
+                continue
+            field_to_lock = {
+                f: lock for lock, fields in table.items() for f in fields
+            }
+            # a guarded field __init__ never assigns is almost certainly
+            # a typo — the misspelled name would guard nothing, forever
+            init_attrs = _init_assigned_attrs(cls)
+            if init_attrs is not None:
+                for f in sorted(set(field_to_lock) - init_attrs):
+                    findings.append(Finding(
+                        "DTL051", sf.path, cls.lineno,
+                        f"{cls.name}._GUARDED_BY declares field "
+                        f"`{f}` that __init__ never assigns — typo'd "
+                        f"names guard nothing",
+                        anchor=f"{cls.name}:_GUARDED_BY:{f}",
+                    ))
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name == "__init__" or method.name.endswith("_locked"):
+                    continue
+                _walk_method(sf, cls, method, field_to_lock, findings)
+    return findings
+
+
+def _walk_method(sf: SourceFile, cls: ast.ClassDef, method: ast.FunctionDef,
+                 field_to_lock: Dict[str, str],
+                 findings: List[Finding]) -> None:
+    locks = set(field_to_lock.values())
+
+    def visit(node: ast.AST, held: frozenset) -> None:
+        if isinstance(node, ast.With):
+            acquired = {
+                lock for item in node.items
+                for lock in locks
+                if _is_self_attr(item.context_expr, lock)
+            }
+            for item in node.items:
+                visit(item.context_expr, held)
+            for stmt in node.body:
+                visit(stmt, held | frozenset(acquired))
+            return
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in field_to_lock):
+            lock = field_to_lock[node.attr]
+            if lock not in held:
+                findings.append(Finding(
+                    "DTL051", sf.path, node.lineno,
+                    f"{cls.name}.{method.name} accesses guarded field "
+                    f"`self.{node.attr}` outside `with self.{lock}` "
+                    f"(declare the method *_locked if the caller holds "
+                    f"the lock)",
+                    anchor=f"{cls.name}.{method.name}:{node.attr}",
+                ))
+            # still recurse into the value chain? self.<field>.x — the
+            # access itself was the finding; no deeper guarded attrs here
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in method.body:
+        visit(stmt, frozenset())

@@ -1,0 +1,404 @@
+"""DTL011-014: jit purity / retrace-hazard checker.
+
+Finds ``jax.jit`` / ``pjit`` / ``shard_map`` wrap sites (decorator form,
+``partial(jax.jit, ...)`` form, and call form ``jit(fn)`` /
+``shard_map(fn, ...)`` where ``fn`` resolves to a function in the same
+module) and, inside the wrapped functions, flags host-level constructs
+that are either trace errors waiting for the right input or silent
+retrace/staleness hazards:
+
+* **DTL011** — a Python ``if``/``while`` whose test references a traced
+  value. Static arguments (``static_argnums``/``static_argnames``) and
+  closure constants are excluded; ``x is None`` / ``x is not None``
+  structure checks are exempt (None-vs-tracer is decided at trace time
+  by design).
+* **DTL012** — a host sync on a traced value: ``.item()``,
+  ``float()/int()/bool()``, ``np.asarray``/``np.array``.
+* **DTL013** — an impure host call (``time.*``, stdlib ``random.*``,
+  ``np.random.*``) anywhere jit-reachable: its value is captured ONCE at
+  trace time, so the code reads like it varies per call and doesn't.
+  Applied to wrapped functions AND same-module functions they call
+  (``jax.random.*`` is functional and exempt).
+* **DTL014** — a read of a mutable module-level container (list/dict/set
+  global) inside a wrapped function: cached traces ignore later
+  mutation, the classic "I toggled the global and nothing changed" bug.
+
+Taint tracking is deliberately lexical and shallow (parameters, then
+single-assignment propagation; ``.shape``/``.dtype``/``.ndim`` reads are
+untainted): the goal is review-time signal on real hazards, not a type
+system. False positives get an inline ``# dtl: disable=`` with a reason,
+which is itself documentation.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile, dotted_name
+
+_JIT_WRAPPERS = {"jit", "jax.jit", "pjit", "jax.experimental.pjit.pjit"}
+_SHARD_WRAPPERS = {"shard_map", "jax.shard_map",
+                   "jax.experimental.shard_map.shard_map"}
+_PARTIALS = {"partial", "functools.partial"}
+
+# attribute reads that yield host-static metadata, never a tracer
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding"}
+
+# dotted call prefixes that are impure at trace time (DTL013)
+_IMPURE_PREFIXES = (
+    "time.", "np.random.", "numpy.random.", "random.",
+    "datetime.datetime.now", "datetime.date.today",
+)
+# ... except jax.random, which is functional
+_PURE_PREFIXES = ("jax.random.",)
+
+_HOST_CASTS = {"float", "int", "bool"}
+_HOST_ARRAY_FNS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                   "onp.asarray", "onp.array"}
+
+
+def _call_resolves_to(node: ast.AST, names: Set[str]) -> bool:
+    d = dotted_name(node)
+    return d is not None and d in names
+
+
+class _WrapSite:
+    def __init__(self, fn: ast.FunctionDef, static_idx: Set[int],
+                 static_names: Set[str], kind: str):
+        self.fn = fn
+        self.static_idx = static_idx
+        self.static_names = static_names
+        self.kind = kind  # "jit" | "shard_map"
+
+
+def _static_spec(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    """static_argnums/static_argnames out of a jit(...) or
+    partial(jax.jit, ...) call's keywords."""
+    idx: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for el in elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                    idx.add(el.value)
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+            for el in elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    names.add(el.value)
+    return idx, names
+
+
+def _wrap_sites(tree: ast.AST) -> List[_WrapSite]:
+    """All functions in the module wrapped by jit/pjit/shard_map —
+    decorator, partial-decorator, or call form."""
+    fns_by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns_by_name.setdefault(node.name, []).append(node)
+
+    sites: List[_WrapSite] = []
+    seen: Set[int] = set()
+
+    def add(fn: ast.FunctionDef, static_idx: Set[int],
+            static_names: Set[str], kind: str) -> None:
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        sites.append(_WrapSite(fn, static_idx, static_names, kind))
+
+    # decorator form
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if _call_resolves_to(dec, _JIT_WRAPPERS):
+                add(node, set(), set(), "jit")
+            elif isinstance(dec, ast.Call):
+                if _call_resolves_to(dec.func, _JIT_WRAPPERS):
+                    idx, names = _static_spec(dec)
+                    add(node, idx, names, "jit")
+                elif (_call_resolves_to(dec.func, _PARTIALS) and dec.args
+                      and _call_resolves_to(dec.args[0], _JIT_WRAPPERS)):
+                    idx, names = _static_spec(dec)
+                    add(node, idx, names, "jit")
+                elif _call_resolves_to(dec.func, _SHARD_WRAPPERS) or (
+                    _call_resolves_to(dec.func, _PARTIALS) and dec.args
+                    and _call_resolves_to(dec.args[0], _SHARD_WRAPPERS)
+                ):
+                    add(node, set(), set(), "shard_map")
+
+    # call form: jit(fn, ...) / shard_map(fn, ...) with fn a same-module def
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = None
+        if _call_resolves_to(node.func, _JIT_WRAPPERS):
+            kind = "jit"
+        elif _call_resolves_to(node.func, _SHARD_WRAPPERS):
+            kind = "shard_map"
+        if kind is None or not node.args:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Name):
+            for fn in fns_by_name.get(target.id, ()):
+                idx, names = _static_spec(node) if kind == "jit" else (set(), set())
+                add(fn, idx, names, kind)
+    return sites
+
+
+def _param_names(fn: ast.FunctionDef, static_idx: Set[int],
+                 static_names: Set[str]) -> Set[str]:
+    args = fn.args
+    ordered = list(args.posonlyargs) + list(args.args)
+    traced: Set[str] = set()
+    for i, a in enumerate(ordered):
+        if i in static_idx or a.arg in static_names or a.arg == "self":
+            continue
+        traced.add(a.arg)
+    for a in args.kwonlyargs:
+        if a.arg not in static_names:
+            traced.add(a.arg)
+    return traced
+
+
+def _expr_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    """Does this expression (conservatively, lexically) carry a traced
+    value? Static-metadata attribute reads break the chain."""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in _STATIC_ATTRS:
+            return False
+        return _expr_tainted(node.value, tainted)
+    if isinstance(node, ast.Call):
+        d = dotted_name(node.func)
+        if d == "len":
+            return False
+        return any(_expr_tainted(a, tainted) for a in node.args) or any(
+            _expr_tainted(kw.value, tainted) for kw in node.keywords
+        ) or _expr_tainted(node.func, tainted)
+    if isinstance(node, (ast.BinOp,)):
+        return _expr_tainted(node.left, tainted) or _expr_tainted(node.right, tainted)
+    if isinstance(node, ast.UnaryOp):
+        return _expr_tainted(node.operand, tainted)
+    if isinstance(node, ast.BoolOp):
+        return any(_expr_tainted(v, tainted) for v in node.values)
+    if isinstance(node, ast.Compare):
+        return _expr_tainted(node.left, tainted) or any(
+            _expr_tainted(c, tainted) for c in node.comparators
+        )
+    if isinstance(node, ast.Subscript):
+        return _expr_tainted(node.value, tainted)
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return any(_expr_tainted(e, tainted) for e in node.elts)
+    if isinstance(node, ast.IfExp):
+        return any(_expr_tainted(n, tainted)
+                   for n in (node.test, node.body, node.orelse))
+    return False
+
+
+def _taint(fn: ast.FunctionDef, params: Set[str]) -> Set[str]:
+    """Parameters plus names assigned from tainted expressions (two
+    fixpoint passes cover the straight-line chains that occur in
+    practice)."""
+    tainted = set(params)
+    for _ in range(2):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _expr_tainted(node.value, tainted):
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+            elif isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Name):
+                if _expr_tainted(node.value, tainted) or node.target.id in tainted:
+                    tainted.add(node.target.id)
+    return tainted
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    )
+
+
+def _mutable_globals(tree: ast.Module) -> Dict[str, int]:
+    """Module-level names bound to mutable containers, with their line."""
+    out: Dict[str, int] = {}
+    mutable_ctors = {"list", "dict", "set", "collections.deque",
+                     "collections.defaultdict", "deque", "defaultdict"}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        is_mut = isinstance(v, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                ast.DictComp, ast.SetComp))
+        if isinstance(v, ast.Call):
+            d = dotted_name(v.func)
+            is_mut = is_mut or (d in mutable_ctors)
+        if not is_mut:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = node.lineno
+    return out
+
+
+def _callees(fn: ast.FunctionDef,
+             fns_by_name: Dict[str, List[ast.FunctionDef]],
+             seen: Set[int]) -> List[ast.FunctionDef]:
+    """Same-module functions (transitively) called by name from ``fn`` —
+    the jit-reachable set for the impurity check."""
+    out: List[ast.FunctionDef] = []
+    stack = [fn]
+    while stack:
+        cur = stack.pop()
+        for node in ast.walk(cur):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                for callee in fns_by_name.get(node.func.id, ()):
+                    if id(callee) not in seen and callee is not fn:
+                        seen.add(id(callee))
+                        out.append(callee)
+                        stack.append(callee)
+    return out
+
+
+def check(files: Sequence[SourceFile], config,
+          full: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        tree = sf.tree
+        assert isinstance(tree, ast.Module)
+        sites = _wrap_sites(tree)
+        if not sites:
+            continue
+        fns_by_name: Dict[str, List[ast.FunctionDef]] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns_by_name.setdefault(node.name, []).append(node)
+        mut_globals = _mutable_globals(tree)
+        imports = _import_aliases(tree)
+        reached: Set[int] = {id(s.fn) for s in sites}
+
+        for site in sites:
+            fn = site.fn
+            params = _param_names(fn, site.static_idx, site.static_names)
+            tainted = _taint(fn, params)
+            local_defs = {
+                n.name for n in ast.walk(fn)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not fn
+            }
+
+            for node in ast.walk(fn):
+                # DTL011: host control flow on a traced value
+                if isinstance(node, (ast.If, ast.While)):
+                    if (_expr_tainted(node.test, tainted)
+                            and not _is_none_check(node.test)):
+                        findings.append(Finding(
+                            "DTL011", sf.path, node.lineno,
+                            f"`{fn.name}` ({site.kind}-wrapped) branches "
+                            f"host-side on a traced value — a retrace "
+                            f"hazard or trace error; use lax.cond/select "
+                            f"or mark the argument static",
+                            anchor=f"{fn.name}:{type(node).__name__}",
+                        ))
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func)
+                # DTL012: host syncs
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"
+                        and _expr_tainted(node.func.value, tainted)):
+                    findings.append(Finding(
+                        "DTL012", sf.path, node.lineno,
+                        f"`{fn.name}` calls .item() on a traced value — "
+                        f"a device sync inside jit",
+                        anchor=f"{fn.name}:item",
+                    ))
+                elif (d in _HOST_CASTS and node.args
+                      and _expr_tainted(node.args[0], tainted)):
+                    findings.append(Finding(
+                        "DTL012", sf.path, node.lineno,
+                        f"`{fn.name}` applies {d}() to a traced value — "
+                        f"a trace error / host sync; keep it on-device "
+                        f"(jnp cast) or mark the argument static",
+                        anchor=f"{fn.name}:{d}",
+                    ))
+                elif (d in _HOST_ARRAY_FNS and node.args
+                      and _expr_tainted(node.args[0], tainted)):
+                    findings.append(Finding(
+                        "DTL012", sf.path, node.lineno,
+                        f"`{fn.name}` materializes a traced value with "
+                        f"{d}() — a host sync inside jit (use jnp.asarray)",
+                        anchor=f"{fn.name}:{d}",
+                    ))
+                # DTL014: mutable module-global closure
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id in mut_globals
+                        and node.id not in tainted
+                        and node.id not in local_defs):
+                    findings.append(Finding(
+                        "DTL014", sf.path, node.lineno,
+                        f"`{fn.name}` closes over mutable module global "
+                        f"`{node.id}` — cached traces freeze its trace-"
+                        f"time contents and ignore later mutation",
+                        anchor=f"{fn.name}:{node.id}",
+                    ))
+
+            # DTL013: impure calls, wrapped fn + same-module callees
+            for body_fn in [fn] + _callees(fn, fns_by_name, reached):
+                findings.extend(
+                    _impure_calls(sf, body_fn, imports, origin=fn.name)
+                )
+    return findings
+
+
+def _import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """local alias -> real module ('np' -> 'numpy')."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[(a.asname or a.name.split(".")[0])] = a.name
+    return out
+
+
+def _impure_calls(sf: SourceFile, fn: ast.FunctionDef,
+                  imports: Dict[str, str], origin: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func)
+        if d is None:
+            continue
+        # normalize the leading alias to the real module name
+        head, _, rest = d.partition(".")
+        real = imports.get(head, head)
+        full = f"{real}.{rest}" if rest else real
+
+        def matches(prefixes) -> bool:
+            return any(
+                full.startswith(p) if p.endswith(".") else full == p
+                for p in prefixes
+            )
+
+        if matches(_PURE_PREFIXES):
+            continue
+        if matches(_IMPURE_PREFIXES):
+            where = (f"`{fn.name}`" if fn.name == origin
+                     else f"`{fn.name}` (reached from jitted `{origin}`)")
+            findings.append(Finding(
+                "DTL013", sf.path, node.lineno,
+                f"{where} calls {d}() inside a traced region — the value "
+                f"is frozen at trace time (pass it in as an argument)",
+                anchor=f"{fn.name}:{full}",
+            ))
+    return findings

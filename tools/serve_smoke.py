@@ -48,6 +48,26 @@ sys.path.insert(0, str(REPO))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def lint_preflight(label: str = "serve smoke") -> int:
+    """Static-analysis pre-flight (docs/DESIGN.md §11): run
+    ``tools/lint.py --check`` before any engine spins up, so a tree that
+    violates the machine-checked invariants (jit purity, import layers,
+    fault-site/telemetry-name registries, lock discipline) fails the
+    gate in milliseconds instead of mid-drill. Subprocess on purpose:
+    the linter is stdlib-only and must not inherit this process's jax
+    initialization."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), "--check"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    if proc.returncode != 0:
+        print(f"{label} FAILED: lint pre-flight found invariant "
+              f"violations:\n{proc.stdout}{proc.stderr}", file=sys.stderr)
+    return proc.returncode
+
+
 def build_tiny_model():
     """The gate's model: tiny, rotary, shift-tokens — built in-process so
     the gate needs no checkpoint. Shared with tools/telemetry_smoke.py."""
@@ -143,6 +163,9 @@ def main(argv=None) -> int:
     n_replicas = (
         int(argv[argv.index("--replicas") + 1]) if "--replicas" in argv else 0
     )
+
+    if lint_preflight() != 0:
+        return 1
 
     dalle, params = build_tiny_model()
     rng = np.random.RandomState(1)

@@ -57,6 +57,15 @@ def main(argv=None) -> int:
     else:
         out_dir = tempfile.mkdtemp(prefix="dalle_telemetry_smoke_")
 
+    import serve_smoke
+
+    # static-analysis pre-flight (docs/DESIGN.md §11): a corrupt tree
+    # fails fast, before the recorder or any engine exists. serve_smoke
+    # would also run it, but this gate must fail even when a future
+    # refactor stops composing the two.
+    if serve_smoke.lint_preflight(label="telemetry smoke") != 0:
+        return 1
+
     from dalle_pytorch_tpu.utils.metrics import counters
     from dalle_pytorch_tpu.utils.telemetry import (
         TELEMETRY,
@@ -64,8 +73,6 @@ def main(argv=None) -> int:
     )
 
     TELEMETRY.configure(enabled=True, flight_dir=out_dir)
-
-    import serve_smoke
 
     rc = serve_smoke.main()
     if rc != 0:
