@@ -48,6 +48,72 @@ PEAK_HBM_BPS = {
 
 DEPTH, DIM, HEADS, DIM_HEAD = 12, 1024, 16, 64
 TEXT_SEQ, IMAGE_FMAP = 256, 32
+
+
+# ------------------------------------------------------- compile counting
+# Recompiles are a first-class serving metric (a shape-drift recompile
+# mid-trace is latency the percentiles silently eat): every throughput/
+# serve record carries compile counts so a recompile regression shows up
+# in BENCH_r*.json, not just in a p99 mystery. Two complementary
+# counters: a global XLA backend-compile event listener, and per-jit
+# signature-cache sizes for the serving hot loop (the same jits
+# `tools/lint.py --trace` holds to a committed signature budget).
+
+_BACKEND_COMPILES = {"n": 0, "installed": False, "available": True}
+
+
+def _install_compile_listener():
+    if _BACKEND_COMPILES["installed"]:
+        return
+    _BACKEND_COMPILES["installed"] = True
+    try:
+        import jax.monitoring as _monitoring
+
+        def _on_duration(name, _secs, **_kw):
+            if name == "/jax/core/compile/backend_compile_duration":
+                _BACKEND_COMPILES["n"] += 1
+
+        _monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # monitoring API drift: degrade, never break bench
+        _BACKEND_COMPILES["available"] = False
+
+
+def backend_compiles() -> int:
+    """Total XLA backend compiles observed so far (−1: listener API
+    unavailable)."""
+    _install_compile_listener()
+    return _BACKEND_COMPILES["n"] if _BACKEND_COMPILES["available"] else -1
+
+
+def serving_jit_signatures() -> dict:
+    """Compiled-signature count per serving hot-loop jit (the
+    `_cache_size` of each jit's trace cache). Steady state after warmup:
+    deltas must be ZERO — `_decode_jit` in particular is contracted to
+    exactly one signature per engine config (DTL11x)."""
+    from dalle_pytorch_tpu.models import sampling as _sampling
+    from dalle_pytorch_tpu.serving import engine as _engine
+
+    fns = {
+        "prefill": _engine._prefill_jit,
+        "prefill_chunk": _engine._prefill_chunk_jit,
+        "prefill_last": _engine._prefill_last_jit,
+        "decode": _engine._decode_jit,
+        "decode_tokens": _sampling.decode_tokens,
+    }
+    out = {}
+    for name, fn in fns.items():
+        try:
+            out[name] = int(fn._cache_size())
+        except Exception:
+            out[name] = -1
+    return out
+
+
+def _sig_delta(after: dict, before: dict) -> dict:
+    return {
+        k: (after[k] - before[k] if after[k] >= 0 and before[k] >= 0 else -1)
+        for k in after
+    }
 NUM_TEXT, NUM_IMAGE = 10000, 8192
 BATCH = 8
 
@@ -145,17 +211,22 @@ def bench_decode_sweep(on_cpu: bool, batch_sizes=(1, 8, 16, 32, 64),
                     dalle, params, text, key, cache_format=fmt
                 )
 
+            bc0 = backend_compiles()
             np.asarray(gen(jax.random.key(0)))  # compile
+            bc1 = backend_compiles()
             times = []
             for i in range(2 if on_cpu else 3):
                 t0 = time.perf_counter()
                 np.asarray(gen(jax.random.key(i)))
                 times.append(time.perf_counter() - t0)
+            bc2 = backend_compiles()
             p50 = float(np.percentile(times, 50))
             tps = b * fmap * fmap / p50
             rec = {
                 "metric": f"decode_sweep_tokens_per_sec_batch{b}_{fmt}"
                           + ("_int8" if int8 else ""),
+                "compiles_warm": bc1 - bc0 if bc0 >= 0 else -1,
+                "compiles_timed": bc2 - bc1 if bc1 >= 0 else -1,
                 "value": round(tps, 1),
                 "unit": "tokens/sec",
                 "vs_baseline": None,
@@ -227,14 +298,19 @@ def bench_continuous_batching(on_cpu: bool, int8: bool = True):
         return tok
 
     tok = jnp.zeros((b,), jnp.int32)
+    bc0 = backend_compiles()
     np.asarray(run(cache, pos0, tok))  # compile + warm
+    bc1 = backend_compiles()
     t0 = time.perf_counter()
     np.asarray(run(cache, pos0, tok))
     dt = time.perf_counter() - t0
+    bc2 = backend_compiles()
     tps = b * n_steps / dt
     return {
         "metric": "decode_continuous_batching_tokens_per_sec_batch"
                   f"{b}" + ("_int8" if int8 else ""),
+        "compiles_warm": bc1 - bc0 if bc0 >= 0 else -1,
+        "compiles_timed": bc2 - bc1 if bc1 >= 0 else -1,
         "value": round(tps, 1),
         "unit": "tokens/sec",
         "vs_baseline": None,
@@ -289,12 +365,18 @@ def bench_serve(on_cpu: bool, int8: bool = True, seed: int = 0):
         # counted and reported — bounded memory is part of the contract)
         TELEMETRY.configure(enabled=telemetry_on, ring_size=1 << 15)
         engine = Engine(dalle, params, cfg)
-        # warm the jits outside the timed trace (compile is not latency)
+        sig0, bc0 = serving_jit_signatures(), backend_compiles()
+        # warm the jits outside the timed trace (compile is not latency);
+        # max_new_tokens=2 so the warm request runs a real decode step —
+        # at 1 it completed at admission and left _decode_jit's compile
+        # INSIDE the timed window (visible as compiles_in_trace=1 before
+        # this fix)
         warm = Request(request_id="__warm__",
                        prompt=np.zeros(TEXT_SEQ, np.int32),
-                       max_new_tokens=1, seed=0)
+                       max_new_tokens=2, seed=0)
         engine.submit(warm)
         engine.run()
+        sig1, bc1 = serving_jit_signatures(), backend_compiles()
         histograms.reset()  # percentiles cover the timed trace only
         c0 = {k: counters.get(f"serve.{k}") for k in
               ("rejected", "preempted", "deadline_exceeded", "completed")}
@@ -325,6 +407,7 @@ def bench_serve(on_cpu: bool, int8: bool = True, seed: int = 0):
                 time.sleep(min(0.005, max(0.0, arrivals[submitted] - now)))
         wall = engine.clock.now() - t0
         check_accounting(engine)
+        sig2, bc2 = serving_jit_signatures(), backend_compiles()
         done = [
             r for r in engine.results.values()
             if r.outcome is Outcome.COMPLETED and r.request_id != "__warm__"
@@ -337,6 +420,14 @@ def bench_serve(on_cpu: bool, int8: bool = True, seed: int = 0):
             "occ": occ_samples,
             "pool_pages": engine.pool.total,
             "dropped": TELEMETRY.dropped,
+            # compile accounting: warm pays for signatures, the timed
+            # trace must not (jit deltas all zero = no recompile
+            # regression; the backend count additionally catches compiles
+            # OUTSIDE the serving jits, e.g. per-slot cache-insert ops)
+            "compiles_warm": bc1 - bc0 if bc0 >= 0 else -1,
+            "compiles_trace": bc2 - bc1 if bc1 >= 0 else -1,
+            "jit_signatures_warm": _sig_delta(sig1, sig0),
+            "jit_recompiles_trace": _sig_delta(sig2, sig1),
         }
 
     def pct(name: str, q: float) -> float:
@@ -395,6 +486,19 @@ def bench_serve(on_cpu: bool, int8: bool = True, seed: int = 0):
         "tokens_per_sec_telemetry_on": round(on["tps"], 1),
         "telemetry_overhead_frac": round(float(overhead), 4),
         "telemetry_ring_dropped": on["dropped"],
+        # recompile regressions as a first-class metric: compile counts
+        # per run phase (warm vs timed trace), per serving jit and
+        # backend-wide. Healthy steady state: every *_in_trace count is 0
+        # — the telemetry-OFF (headline) run is the source, the ON run is
+        # cross-checked to confirm telemetry adds no compiles
+        "compiles_warm": off["compiles_warm"],
+        "compiles_in_trace": off["compiles_trace"],
+        "compiles_in_trace_telemetry_on": on["compiles_trace"],
+        "jit_signatures_warm": off["jit_signatures_warm"],
+        "jit_recompiles_in_trace": off["jit_recompiles_trace"],
+        "compile_counter_source": "jax.monitoring backend_compile events "
+                                  "+ per-jit _cache_size deltas "
+                                  "(-1 = counter unavailable)",
         "mean_interarrival_s": mean_ia,
         "arrival_seed": seed,
         "max_batch": max_batch,
@@ -1065,12 +1169,15 @@ def bench_gen_throughput(on_cpu: bool, batch_sizes=(8, 32), int8: bool = True,
         def gen(key):
             return generate_image_tokens(dalle, params, text, key)
 
+        bc0 = backend_compiles()
         np.asarray(gen(jax.random.key(0)))  # compile
+        bc1 = backend_compiles()
         times = []
         for i in range(2 if on_cpu else 3):
             t0 = time.perf_counter()
             np.asarray(gen(jax.random.key(i)))
             times.append(time.perf_counter() - t0)
+        bc2 = backend_compiles()
         p50 = float(np.percentile(times, 50))
         tps = b * fmap * fmap / p50
         if b == 1:
@@ -1079,6 +1186,8 @@ def bench_gen_throughput(on_cpu: bool, batch_sizes=(8, 32), int8: bool = True,
         results.append({
             "metric": f"gen_throughput_tokens_per_sec_batch{b}"
                       + ("_int8" if int8 else ""),
+            "compiles_warm": bc1 - bc0 if bc0 >= 0 else -1,
+            "compiles_timed": bc2 - bc1 if bc1 >= 0 else -1,
             "value": round(tps, 1),
             "unit": "tokens/sec",
             "vs_baseline": None,

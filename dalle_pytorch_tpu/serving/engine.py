@@ -169,7 +169,7 @@ class _Slot:
         self.tok_on_device = False
 
 
-@partial(jax.jit, static_argnums=(0, 5))
+@partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2,))
 def _prefill_jit(dalle: DALLE, params, cache, internal_text, key, k: int,
                  temperature):
     """One parallel prefill over the full text prompt + the first image
@@ -178,7 +178,16 @@ def _prefill_jit(dalle: DALLE, params, cache, internal_text, key, k: int,
     ``[ext:]`` (models/dalle.py:_head_image) but without dequantizing the
     text-vocab columns or running the full-vocab mask chain; with the
     full-vocab-derived ``k`` the top-k threshold matches the reference's
-    fractional-k semantics exactly (models/sampling.py)."""
+    fractional-k semantics exactly (models/sampling.py).
+
+    The cache argument is DONATED (as in every serving jit here): the
+    output cache aliases the input's buffers in HBM instead of
+    double-buffering the paged KV pool for the duration of the call.
+    Callers must treat the passed-in cache as consumed — the engine hands
+    this jit a private copy of its pristine template
+    (``_fresh_prefill_cache``), never ``_fresh1`` itself. The aliasing is
+    a lint contract: ``tools/lint.py --trace`` DTL12x checks the lowered
+    computation, not just this decorator."""
     img, mutated = dalle.apply(
         {"params": params, "cache": cache},
         internal_text,
@@ -192,10 +201,12 @@ def _prefill_jit(dalle: DALLE, params, cache, internal_text, key, k: int,
     return mutated["cache"], tok
 
 
-@partial(jax.jit, static_argnums=(0,))
+@partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def _prefill_chunk_jit(dalle: DALLE, params, cache, chunk, start):
     """One intermediate prefill chunk: text positions [start, start+c)
-    written into the batch-1 cache; no logits (the head is skipped)."""
+    written into the batch-1 cache; no logits (the head is skipped).
+    The cache is donated — chunk N+1's cache lives in chunk N's buffers,
+    so a chunked prefill holds ONE batch-1 cache in HBM, not two."""
     _, mutated = dalle.apply(
         {"params": params, "cache": cache},
         chunk, start,
@@ -206,14 +217,14 @@ def _prefill_chunk_jit(dalle: DALLE, params, cache, chunk, start):
     return mutated["cache"]
 
 
-@partial(jax.jit, static_argnums=(0, 5))
+@partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2,))
 def _prefill_last_jit(dalle: DALLE, params, cache, chunk, start, k: int,
                       key, temperature):
     """The FINAL prefill chunk + the first image token sampled from its
     logits — the exact head + sampling ops of ``_prefill_jit`` (same
     image-only head columns, same full-vocab-derived k), so chunked and
     monolithic prefill draw the same token from the same
-    ``fold_in(key(seed), T)`` key."""
+    ``fold_in(key(seed), T)`` key. Cache donated, like every serving jit."""
     img, mutated = dalle.apply(
         {"params": params, "cache": cache},
         chunk, start,
@@ -227,12 +238,16 @@ def _prefill_last_jit(dalle: DALLE, params, cache, chunk, start, k: int,
     return mutated["cache"], tok
 
 
-@partial(jax.jit, static_argnums=(0, 6))
+@partial(jax.jit, static_argnums=(0, 6), donate_argnums=(2,))
 def _decode_jit(dalle: DALLE, params, cache, tok, pos, keys, k: int,
                 temperature):
     """One vector-position decode step over every slot; per-slot PRNG keys
     (vmapped categorical) keep each row's sample stream independent of the
-    batch composition around it."""
+    batch composition around it. The batched cache is donated: the step's
+    output cache aliases the input's buffers, so steady-state decode holds
+    ONE copy of the paged KV pool in HBM instead of double-buffering it
+    every token (the engine reassigns ``self.cache`` from the return value
+    and never touches the consumed input again)."""
     logits, mutated = dalle.apply(
         {"params": params, "cache": cache},
         tok, pos,
@@ -316,8 +331,12 @@ class Engine:
             init_decode_cache(dalle, params, B, cache_format="paged"),
             jnp.zeros((B,), jnp.int32),
         )
-        # pristine batch-1 cache, reused as every prefill's starting state
-        # (jax arrays are immutable, so sharing it is safe)
+        # pristine batch-1 cache, the TEMPLATE every prefill starts from.
+        # The prefill jits donate their cache argument (the output aliases
+        # the input in HBM), so this template itself must never be passed
+        # in — callers go through _fresh_prefill_cache(), which hands the
+        # jit a private copy (one small memcpy per admission vs
+        # double-buffering the cache for every prefill call).
         self._fresh1 = set_decode_offsets(
             init_decode_cache(dalle, params, 1, cache_format="paged"),
             jnp.zeros((1,), jnp.int32),
@@ -589,7 +608,7 @@ class Engine:
             admit_seq=self._admit_seq, phase=_PREFILL,
         )
         self._admit_seq += 1
-        slot.cache1 = self._fresh1
+        slot.cache1 = self._fresh_prefill_cache()
         text = jnp.asarray(entry.request.prompt, jnp.int32)[None, :]
         slot.internal = self.dalle.remap_text(text)
         slot.filled = 0
@@ -640,6 +659,14 @@ class Engine:
         eff_max_new, _ = self._clamped_budget(request.max_new_tokens)
         return self._worst_case_pages(eff_max_new) <= self.pool.free
 
+    def _fresh_prefill_cache(self):
+        """A donate-safe copy of the pristine batch-1 cache template: the
+        prefill jits consume (donate) their cache argument, and donating
+        ``_fresh1`` itself would invalidate the template for every later
+        admission (a real invalidation — jax deletes donated buffers on
+        CPU too, so tests catch any template reuse)."""
+        return jax.tree_util.tree_map(jnp.copy, self._fresh1)
+
     def _worst_case_pages(self, max_new: int) -> int:
         # positions WRITTEN to cache: the prompt (T) plus every generated
         # token except the last (a sampled token is cached only when the
@@ -656,8 +683,8 @@ class Engine:
             jax.random.key(entry.request.seed), self.T
         )
         cache1, tok = _prefill_jit(
-            self.dalle, self.params, self._fresh1, internal, key,
-            self.k_img, self.config.temperature,
+            self.dalle, self.params, self._fresh_prefill_cache(), internal,
+            key, self.k_img, self.config.temperature,
         )
         return cache1, int(tok[0])
 

@@ -1,0 +1,117 @@
+"""Known-bad trace-audit fixtures (tests/test_static_analysis.py).
+
+A miniature entry-point registry with >=2 seeded violations per DTL1xx
+checker family, paired with fx_trace_contract.json. Loaded by FILE PATH
+through ``lint.trace.audit._load_registry`` exactly like the real
+registry; every jit here is a few-op toy so the whole fixture audit
+traces in milliseconds.
+
+Seeded violations (pinned in TestTrace):
+
+* DTL101 — ``fx.uncommitted`` registered here, absent from the contract
+* DTL102 — ``fx.ghost`` present only in the contract
+* DTL111/DTL113 — ``fx.drift`` produces two signatures; the contract
+  lists one and budgets one
+* DTL112 — the contract lists a ``float32[12]`` signature for
+  ``fx.drift`` that this registry never produces
+* DTL121 — ``fx.not_donated`` declares a donated arg its jit does not
+  donate; ``fx.undeclared`` donates without declaring
+* DTL122 — ``fx.unaliased`` donates an arg no output can alias;
+  ``fx.plain`` declares donation on a non-jitted callable
+* DTL131/DTL132 — ``fx.chatty`` embeds two debug callbacks and returns
+  three host-visible outputs against budgets of 0/1
+* DTL141 — ``fx.fat`` and ``fx.fat2`` exceed their byte budgets;
+  ``fx.fat3`` also exceeds but is inline-suppressed (the escape hatch)
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from lint.trace.types import EntryPoint, Signature
+
+_PATH = "tests/fixtures_lint/fx_trace_registry.py"
+_SDS = jax.ShapeDtypeStruct
+_F8 = _SDS((8,), jnp.float32)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _donated_ok(x, y):
+    return x + y, jnp.sum(y)
+
+
+@jax.jit
+def _not_donated(x, y):
+    return x + y
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _unaliased(x, y):
+    # x is donated but every output is a scalar: nothing can alias it
+    return jnp.sum(x) + jnp.sum(y)
+
+
+def _plain(x):
+    return x * 2.0
+
+
+@jax.jit
+def _chatty(x):
+    jax.debug.print("x={x}", x=x)
+    jax.debug.print("again={x}", x=x)
+    return x * 2, x + 1, x - 1
+
+
+@jax.jit
+def _fat(x):
+    return jnp.concatenate([x, x], 0)
+
+
+@jax.jit
+def _fat2(x):
+    return jnp.tile(x, 3)
+
+
+@jax.jit
+def _fat3(x):  # dtl: disable=DTL141
+    return jnp.tile(x, 4)
+
+
+@jax.jit
+def _drift(x):
+    return x * 2
+
+
+def _ep(name, symbol, fn, sigs, donate=None, lower="auto"):
+    return EntryPoint(
+        name=name, path=_PATH, symbol=symbol, fn=fn,
+        signatures=sigs, static_argnums=(),
+        donate=donate or {},
+        lower=(getattr(fn, "lower", None) if lower == "auto" else lower),
+    )
+
+
+def build_entry_points():
+    return [
+        _ep("fx.donate_ok", "_donated_ok", _donated_ok,
+            [Signature("s", (_F8, _F8))], donate={"x": 0}),
+        _ep("fx.not_donated", "_not_donated", _not_donated,
+            [Signature("s", (_F8, _F8))], donate={"x": 0}),
+        _ep("fx.undeclared", "_donated_ok", _donated_ok,
+            [Signature("s", (_F8, _F8))], donate={}),
+        _ep("fx.unaliased", "_unaliased", _unaliased,
+            [Signature("s", (_F8, _F8))], donate={"x": 0}),
+        _ep("fx.plain", "_plain", _plain,
+            [Signature("s", (_F8,))], donate={"x": 0}, lower=None),
+        _ep("fx.chatty", "_chatty", _chatty, [Signature("s", (_F8,))]),
+        _ep("fx.fat", "_fat", _fat, [Signature("s", (_F8,))]),
+        _ep("fx.fat2", "_fat2", _fat2, [Signature("s", (_F8,))]),
+        _ep("fx.fat3", "_fat3", _fat3, [Signature("s", (_F8,))]),
+        _ep("fx.drift", "_drift", _drift, [
+            Signature("w4", (_SDS((4,), jnp.float32),)),
+            Signature("w6", (_SDS((6,), jnp.float32),)),
+        ]),
+        _ep("fx.uncommitted", "_plain", _plain,
+            [Signature("s", (_F8,))], lower=None),
+    ]

@@ -516,6 +516,123 @@ class TestEngineDecodeParity:
             insert_decode_cache(batched, batched, 0)
 
 
+# ------------------------------------------- donation + compile budget
+
+
+def _leaves_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestDonation:
+    """The serving jits donate their cache (ISSUE 8 satellite): donation
+    must change HBM residency, never tokens — pinned here bit-exactly
+    against non-donating rewraps of the same functions — and the shared
+    pristine template must survive it (jax deletes donated buffers on
+    CPU too, so any template reuse would crash loudly in this suite).
+    The static regression guard is DTL12x in `tools/lint.py --trace`."""
+
+    def test_prefill_and_decode_bit_identical_to_undonated(self, model):
+        from functools import partial
+
+        from dalle_pytorch_tpu.models.sampling import set_decode_offsets
+        from dalle_pytorch_tpu.serving import engine as eng
+
+        dalle, params = model
+        pre_nd = partial(
+            jax.jit, static_argnums=(0, 5)
+        )(eng._prefill_jit.__wrapped__)
+        dec_nd = partial(
+            jax.jit, static_argnums=(0, 6)
+        )(eng._decode_jit.__wrapped__)
+        fresh = set_decode_offsets(
+            init_decode_cache(dalle, params, 1, cache_format="paged"),
+            jnp.zeros((1,), jnp.int32),
+        )
+        text = jnp.asarray(prompt(0), jnp.int32)[None, :]
+        internal = dalle.remap_text(text)
+        T = dalle.text_len_internal
+        k = max(int((1 - 0.9) * dalle.total_tokens), 1)
+        key = jax.random.fold_in(jax.random.key(7), T)
+
+        donated_in = jax.tree_util.tree_map(jnp.copy, fresh)
+        c_d, t_d = eng._prefill_jit(
+            dalle, params, donated_in, internal, key, k, 1.0
+        )
+        c_n, t_n = pre_nd(dalle, params, fresh, internal, key, k, 1.0)
+        assert int(t_d[0]) == int(t_n[0])
+        _leaves_equal(c_d, c_n)
+
+        # one vector-position decode step, donated vs not, equal caches in
+        batched = set_decode_offsets(
+            init_decode_cache(dalle, params, 2, cache_format="paged"),
+            jnp.zeros((2,), jnp.int32),
+        )
+        batched = insert_decode_cache(batched, c_d, 0)
+        batched2 = jax.tree_util.tree_map(jnp.copy, batched)
+        tok = jnp.asarray([int(t_d[0]), 0], jnp.int32)
+        pos = jnp.asarray([T, 0], jnp.int32)
+        keys = jnp.stack([
+            jax.random.fold_in(jax.random.key(7), T + 1),
+            jax.random.key(0),
+        ])
+        cd2, sd = eng._decode_jit(dalle, params, batched, tok, pos, keys, k, 1.0)
+        cn2, sn = dec_nd(dalle, params, batched2, tok, pos, keys, k, 1.0)
+        assert int(sd[0]) == int(sn[0])
+        _leaves_equal(cd2, cn2)
+
+    def test_fresh_template_survives_sequential_prefills(self, model):
+        """Two requests prefilled back-to-back from the same engine: both
+        monolithic prefills start from the SAME pristine template, which
+        the donating jit must therefore never consume directly."""
+        engine = make_engine(model)
+        assert engine.submit(req(0, max_new=3)) is None
+        engine.run(max_steps=200)
+        assert engine.submit(req(1, max_new=3)) is None
+        engine.run(max_steps=200)
+        check_accounting(engine)
+        assert engine.results["r0"].outcome is Outcome.COMPLETED
+        assert engine.results["r1"].outcome is Outcome.COMPLETED
+        assert not any(
+            x.is_deleted() for x in jax.tree_util.tree_leaves(engine._fresh1)
+        ), "donation consumed the shared pristine prefill template"
+
+    def test_decode_jit_compiles_once_steady_state(self, model):
+        """The DTL11x acceptance property at runtime: a multi-request
+        engine run (admissions landing mid-decode, completions freeing
+        slots) feeds `_decode_jit` EXACTLY one compile signature; an
+        injected shape-drifting call compiles a second one — the drift
+        the committed compile-signature contract turns into a lint
+        failure (tests/fixtures_lint: DTL111)."""
+        from dalle_pytorch_tpu.serving import engine as eng
+
+        dalle, params = model
+        # max_batch=5 is used nowhere else in this module: the signature
+        # is fresh, so the compile-count delta is exact, not <=
+        engine = Engine(dalle, params, EngineConfig(max_batch=5),
+                        clock=FakeClock(step_dt=0.1))
+        before = eng._decode_jit._cache_size()
+        for i in range(8):
+            assert engine.submit(req(i, max_new=4)) is None
+        engine.run(max_steps=800)
+        check_accounting(engine)
+        assert all(
+            r.outcome is Outcome.COMPLETED for r in engine.results.values()
+        )
+        assert eng._decode_jit._cache_size() - before == 1, (
+            "steady-state decode recompiled: the engine fed _decode_jit "
+            "more than one (shape, dtype, static) signature"
+        )
+        # inject shape drift: a second engine at a different batch width
+        # is a second signature — exactly what DTL111/DTL113 would flag
+        # if the registry/engine started producing it
+        drift = Engine(dalle, params, EngineConfig(max_batch=6),
+                       clock=FakeClock(step_dt=0.1))
+        assert drift.submit(req(90, max_new=2)) is None
+        drift.run(max_steps=200)
+        assert eng._decode_jit._cache_size() - before == 2
+
+
 # ----------------------------------------------------- release gates
 
 
@@ -565,8 +682,16 @@ def test_bench_serve_record():
               "telemetry_ring_dropped",
               # chunked-prefill era: TTFT percentiles ride the same
               # histogram mechanism as the other splits
-              "ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms"):
+              "ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
+              # compile accounting (ISSUE 8): recompiles are first-class
+              "compiles_warm", "compiles_in_trace",
+              "jit_signatures_warm", "jit_recompiles_in_trace"):
         assert k in r, k
+    # the timed trace must be recompile-free in every serving jit —
+    # the runtime twin of the DTL11x compile-signature contract
+    assert all(v == 0 for v in r["jit_recompiles_in_trace"].values()), r[
+        "jit_recompiles_in_trace"
+    ]
     assert r["completed"] + r["rejected"] + r["deadline_exceeded"] <= r["n_requests"]
     assert r["value"] > 0
     assert r["tokens_per_sec_telemetry_on"] > 0

@@ -13,8 +13,12 @@ Two layers:
    tools/telemetry_smoke.py run. A lint finding anywhere in the tree
    fails the fast tier here, not at the next release drill.
 
-The linter is stdlib-only and never imports the package it checks, so
-everything here runs in milliseconds with no jax involvement.
+The AST stage is stdlib-only and never imports the package it checks,
+so those tests run in milliseconds with no jax involvement. The TRACE
+stage (tools/lint/trace/, ``--trace``, DTL1xx) is the exception by
+design: its fixture registry jits are traced (never executed) with jax
+on CPU, and the repo gate audits the real package's entry points
+against tools/trace_contracts.json.
 """
 
 import json
@@ -341,3 +345,231 @@ class TestRepoGate:
         res = run_lint(default_config(str(REPO)),
                        checkers=["telemetry-names"])
         assert res.clean, [f.render() for f in res.findings]
+
+
+# ------------------------------------------------------- trace stage
+
+
+_TRACE_CACHE: dict = {}
+
+
+def trace_fixture_raw():
+    """Audit the fixture registry once per session (the audit imports jax
+    and traces every fixture jit — cached so each pinned-code test below
+    reads the same result instead of re-tracing)."""
+    if "raw" not in _TRACE_CACHE:
+        from lint.trace import run_trace  # imports jax (fixture jits)
+
+        _TRACE_CACHE["raw"] = run_trace(
+            str(REPO),
+            f"{FX}/fx_trace_registry.py",
+            f"{FX}/fx_trace_contract.json",
+        )
+    return _TRACE_CACHE["raw"]
+
+
+def trace_fixture_result(baseline=None):
+    """Fold the fixture trace findings through the SHARED suppression/
+    baseline machinery (run_lint extra_findings) — the same path the CLI
+    composes the two stages on."""
+    findings, reports = trace_fixture_raw()
+    cfg = fixture_config(baseline_path=baseline)
+    res = run_lint(cfg, paths=[f"{FX}/fx_trace_registry.py"], checkers=[],
+                   full=True, extra_findings=findings)
+    return res, reports
+
+
+class TestTrace:
+    """Fixture corpus for the --trace stage (tools/lint/trace/): >=2
+    seeded violations per DTL1xx checker family at pinned codes and
+    anchors, plus the suppression/baseline escapes and the
+    contract-file round trip."""
+
+    def test_exact_codes_and_anchors(self):
+        res, _ = trace_fixture_result()
+        got = sorted((f.code, f.anchor) for f in res.findings)
+        assert got == [
+            ("DTL101", "fx.uncommitted"),          # registered, uncommitted
+            ("DTL102", "fx.ghost"),                # contract-only: stale
+            ("DTL111", "fx.drift:w6"),             # unlisted signature
+            ("DTL112", "fx.drift:float32[12]"),    # stale signature
+            ("DTL113", "fx.drift"),                # over signature budget
+            ("DTL121", "fx.not_donated:x"),        # declared, not donated
+            ("DTL121", "fx.undeclared:undeclared"),  # donated, undeclared
+            ("DTL122", "fx.plain"),                # declared on non-jit
+            ("DTL122", "fx.unaliased"),            # donated, unaliased
+            ("DTL131", "fx.chatty"),               # 2 callbacks > 0
+            ("DTL132", "fx.chatty"),               # 3 visible outputs > 1
+            ("DTL141", "fx.fat"),                  # HBM over budget
+            ("DTL141", "fx.fat2"),                 # HBM over budget
+        ], [f.render() for f in res.findings]
+
+    def test_inline_suppression(self):
+        # fx.fat3 exceeds its byte budget exactly like fx.fat/fat2 but
+        # carries `# dtl: disable=DTL141` on its def line — the shared
+        # escape hatch works for trace findings too
+        res, _ = trace_fixture_result()
+        assert [(f.code, f.anchor) for f in res.suppressed] == [
+            ("DTL141", "fx.fat3"),
+        ]
+
+    def test_findings_anchor_on_def_lines(self):
+        res, _ = trace_fixture_result()
+        src = (REPO / FX / "fx_trace_registry.py").read_text().splitlines()
+        want = next(
+            i for i, line in enumerate(src, 1)
+            if line.startswith("def _not_donated")
+        )
+        f = next(x for x in res.findings if x.anchor == "fx.not_donated:x")
+        assert f.line == want and f.path == f"{FX}/fx_trace_registry.py"
+
+    def test_clean_entry_stays_clean(self):
+        # fx.donate_ok donates, aliases, and matches its contract exactly
+        res, _ = trace_fixture_result()
+        assert not any("fx.donate_ok" in f.anchor for f in res.findings)
+
+    def test_baseline_grandfathers_with_stable_key(self, tmp_path):
+        bl = tmp_path / "trace_baseline.json"
+        bl.write_text(json.dumps([{
+            "key": f"{FX}/fx_trace_registry.py::DTL113::fx.drift",
+            "note": "fixture: grandfathered signature-budget overrun",
+        }]))
+        res, _ = trace_fixture_result(baseline=str(bl))
+        assert ("DTL113", "fx.drift") not in [
+            (f.code, f.anchor) for f in res.findings
+        ]
+        assert [(f.code, f.anchor) for f in res.baselined] == [
+            ("DTL113", "fx.drift"),
+        ]
+        assert res.stale_baseline == []
+
+    def test_emit_contract_round_trip(self):
+        """A contract regenerated from the current registry must clear
+        every budget/signature finding — what survives is exactly the
+        donation drift between what the registry DECLARES and what the
+        traced programs DO (that divergence is in the code, not the
+        contract, so re-emitting cannot paper over it)."""
+        from lint.trace import check_reports, emit_contract
+
+        _, reports = trace_fixture_raw()
+        fresh = emit_contract(reports)
+        findings = check_reports(
+            reports, fresh, "fresh.json", str(REPO)
+        )
+        got = sorted((f.code, f.anchor) for f in findings)
+        assert got == [
+            ("DTL121", "fx.not_donated:x"),
+            ("DTL121", "fx.undeclared:undeclared"),
+            ("DTL122", "fx.plain"),
+            ("DTL122", "fx.unaliased"),
+        ], got
+
+    def test_trace_baseline_key_not_stale_for_ast_only_scan(self, tmp_path):
+        """A baselined DTL1xx (trace-stage) key must NOT be judged stale
+        by a scan that never ran the trace stage — otherwise one
+        legitimately grandfathered trace finding would fail every plain
+        `--check` run (including the smoke gates' stage-1 AST
+        pre-flight). It IS judged when the trace stage ran (an empty
+        extra_findings list means 'ran, found nothing')."""
+        from lint import Finding  # noqa: F401  (core import side)
+
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps([{
+            "key": f"{FX}/fx_trace_registry.py::DTL141::fx.gone",
+            "note": "trace finding fixed long ago",
+        }]))
+        cfg = fixture_config(baseline_path=str(bl))
+        # AST-only (trace stage did not run): unseen, not stale
+        res = run_lint(cfg, paths=[f"{FX}/fx_purity.py"], checkers=[],
+                       full=True, extra_findings=None)
+        assert res.stale_baseline == []
+        # trace stage ran and produced nothing matching: NOW it is stale
+        res = run_lint(cfg, paths=[f"{FX}/fx_purity.py"], checkers=[],
+                       full=True, extra_findings=[])
+        assert res.stale_baseline == [
+            f"{FX}/fx_trace_registry.py::DTL141::fx.gone"
+        ]
+
+    def test_trace_suppression_survives_narrowed_ast_paths(self):
+        """Trace findings anchor in files the AST stage may not have
+        scanned (narrowed paths); their inline suppressions must load on
+        demand instead of silently going live."""
+        from lint import Finding
+
+        src = (REPO / FX / "fx_trace_registry.py").read_text().splitlines()
+        line = next(
+            i for i, l in enumerate(src, 1) if l.startswith("def _fat3")
+        )
+        fake = Finding(
+            code="DTL141", path=f"{FX}/fx_trace_registry.py", line=line,
+            message="synthetic overrun", anchor="fx.fat3",
+        )
+        res = run_lint(
+            fixture_config(), paths=[f"{FX}/fx_purity.py"], checkers=[],
+            extra_findings=[fake],
+        )
+        assert res.findings == []
+        assert [(f.code, f.anchor) for f in res.suppressed] == [
+            ("DTL141", "fx.fat3"),
+        ]
+
+    def test_hbm_report_shape(self):
+        """The per-entry report carries the per-jit HBM decomposition
+        the DESIGN.md §11 operator workflow reads."""
+        _, reports = trace_fixture_raw()
+        rep = next(r for r in reports if r["name"] == "fx.donate_ok")
+        sig = rep["signatures"][0]
+        assert sig["arg_bytes"] == 64          # two f32[8]
+        assert sig["out_bytes"] == 36          # f32[8] + scalar
+        assert sig["aliased_bytes"] == 32      # donated x aliases out[0]
+        assert sig["hbm_bytes"] == 68
+        assert rep["max_host_visible_outputs"] == 1
+
+
+class TestTraceCLI:
+    """--trace through the real CLI: composition with the AST stage in
+    one exit code, and THE acceptance gate on the repo contract."""
+
+    def test_fixture_registry_fails_check(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"),
+             "--trace", "--check",
+             "--trace-registry", f"{FX}/fx_trace_registry.py",
+             "--contract", f"{FX}/fx_trace_contract.json",
+             f"{FX}/fx_trace_registry.py"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 1, proc.stderr
+        for code in ("DTL111", "DTL121", "DTL122", "DTL131", "DTL132",
+                     "DTL141"):
+            assert code in proc.stdout, (code, proc.stdout)
+        # the suppressed fx.fat3 overrun must NOT be a live finding
+        assert "fx.fat3" not in proc.stdout
+
+    def test_repo_trace_gate_exits_zero(self):
+        """THE acceptance gate: every registered entry point of the real
+        package matches tools/trace_contracts.json — signatures closed,
+        donation aliased, readbacks bounded, HBM inside budget."""
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"),
+             "--trace", "--check"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, (
+            f"lint --trace --check failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+
+    def test_emit_contract_matches_committed(self):
+        """The committed contract is exactly what --emit-contract derives
+        from the current registry — no drift between file and tree."""
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"),
+             "--trace", "--emit-contract"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr
+        emitted = json.loads(proc.stdout)
+        committed = json.loads(
+            (REPO / "tools" / "trace_contracts.json").read_text()
+        )
+        assert emitted == committed

@@ -1,9 +1,10 @@
 #!/usr/bin/env python
-"""dalle-tpu-lint CLI: AST-based invariant checks for this repo.
+"""dalle-tpu-lint CLI: AST + trace-level invariant checks for this repo.
 
 Usage::
 
-    python tools/lint.py [--json] [--check] [--checks a,b,...] [paths...]
+    python tools/lint.py [--json] [--check] [--checks a,b,...]
+                         [--trace] [--emit-contract] [paths...]
 
 * no flags: report findings (human-readable), always exit 0;
 * ``--check``: exit 1 when any non-suppressed, non-baselined finding
@@ -12,14 +13,28 @@ Usage::
 * ``--json``: one JSON object per finding on stdout;
 * ``--checks``: comma list from {purity, layering, fault-sites,
   telemetry-names, locks} (default: all);
-* ``paths``: repo-relative files/dirs to scan (default: the package +
-  CLI entrypoints — see tools/lint/config.py).
+* ``--trace``: ALSO run the semantic stage (tools/lint/trace/): trace
+  every registered jit entry point to a ClosedJaxpr over abstract avals
+  and audit compile signatures, buffer donation/aliasing, host
+  syncs/readbacks, and static HBM footprints against the committed
+  ``tools/trace_contracts.json`` (DTL1xx codes). This stage imports jax
+  and the package (still CPU-only, no device execution) and composes
+  with the AST stage in one exit code;
+* ``--emit-contract`` (with ``--trace``): print the contract JSON
+  derived from the current registry to stdout and exit — the blessed
+  update after an intentional signature/footprint change;
+* ``--trace-registry`` / ``--contract``: override the registry module /
+  contract file (fixture tests use these);
+* ``paths``: repo-relative files/dirs for the AST stage (default: the
+  package + CLI entrypoints — see tools/lint/config.py). The trace
+  stage always audits every registered entry point.
 
 Finding codes, the suppression comment (``# dtl: disable=DTL0xx``), and
 the baseline policy (tools/lint_baseline.json) are documented in
-docs/DESIGN.md §11 and tools/lint/__init__.py. The linter is stdlib-only
-and never imports the package it checks — it runs in milliseconds with
-no jax in sight.
+docs/DESIGN.md §11, tools/lint/__init__.py (DTL0xx), and
+tools/lint/trace/__init__.py (DTL1xx). Without ``--trace`` the linter
+is stdlib-only and never imports the package it checks — it runs in
+milliseconds with no jax in sight.
 """
 
 from __future__ import annotations
@@ -52,6 +67,18 @@ def main(argv=None) -> int:
     ap.add_argument("--baseline", default=None,
                     help="override the baseline file "
                          "(default: tools/lint_baseline.json)")
+    ap.add_argument("--trace", action="store_true",
+                    help="also run the trace-level jaxpr/lowering audit "
+                         "(DTL1xx; imports jax, CPU-only)")
+    ap.add_argument("--emit-contract", action="store_true",
+                    dest="emit_contract",
+                    help="with --trace: print the contract JSON derived "
+                         "from the current registry and exit")
+    ap.add_argument("--contract", default=None,
+                    help="override the trace contract file "
+                         "(default: tools/trace_contracts.json)")
+    ap.add_argument("--trace-registry", default=None, dest="trace_registry",
+                    help="override the trace registry module path")
     ap.add_argument("paths", nargs="*",
                     help="repo-relative files/dirs (default: scan roots)")
     args = ap.parse_args(argv)
@@ -65,8 +92,52 @@ def main(argv=None) -> int:
         [c.strip() for c in args.checks.split(",") if c.strip()]
         if args.checks else None
     )
+
+    trace_findings = None
+    if args.trace:
+        # imported HERE, not at module top: the trace stage pulls in jax
+        # and the audited package; the AST-only invocation stays
+        # stdlib-pure and millisecond-fast. CPU-pinned: the audit is
+        # abstract (eval_shape/make_jaxpr/lower, no execution) and must
+        # not grab an accelerator just to read avals.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from lint.trace import emit_contract, run_trace, trace_reports_only
+
+        tcfg = config.trace
+        registry = args.trace_registry or tcfg.registry_path
+        contract = args.contract or tcfg.contract_path
+        try:
+            if args.emit_contract:
+                reports = trace_reports_only(_REPO_ROOT, registry)
+                print(json.dumps(emit_contract(reports), indent=2))
+                return 0
+            trace_findings, reports = run_trace(
+                _REPO_ROOT, registry, contract
+            )
+        except (ImportError, ValueError, OSError, RuntimeError,
+                SyntaxError) as e:
+            print(f"lint: trace stage error: {e}", file=sys.stderr)
+            return 2
+        if not args.as_json:
+            # the per-jit report (signatures / readbacks / HBM) goes to
+            # stderr: it is operator context, not findings
+            for r in sorted(reports, key=lambda r: r["name"]):
+                print(
+                    f"lint: trace {r['name']}: "
+                    f"{len(r['signatures'])} signature(s), "
+                    f"{r['max_callbacks']} callback(s), "
+                    f"{r['max_host_visible_outputs']} host-visible "
+                    f"output(s), {r['max_hbm_bytes']} HBM bytes "
+                    f"(aliased {r['signatures'][0]['aliased_bytes']})",
+                    file=sys.stderr,
+                )
+    elif args.emit_contract:
+        print("lint: --emit-contract requires --trace", file=sys.stderr)
+        return 2
+
     try:
-        result = run_lint(config, paths=args.paths or None, checkers=checkers)
+        result = run_lint(config, paths=args.paths or None, checkers=checkers,
+                          extra_findings=trace_findings)
     except (ValueError, OSError, SyntaxError) as e:
         print(f"lint: error: {e}", file=sys.stderr)
         return 2

@@ -52,7 +52,15 @@ baseline (``tools/lint_baseline.json``) with a justification note —
 ``--check`` ignores baselined findings but reports stale entries.
 
 Stdlib-``ast`` only, no third-party deps, never imports the package it
-lints (so it runs in milliseconds, jax-free, anywhere).
+lints (so it runs in milliseconds, jax-free, anywhere). The ONE
+exception is the optional second stage under ``tools/lint/trace/``
+(``lint.py --trace``, DTL1xx codes): a semantic audit that traces the
+registered jit entry points to ClosedJaxprs (abstract avals, CPU, no
+execution) and checks compile-signature budgets, buffer donation/
+aliasing, host syncs, and static HBM footprints against the committed
+``tools/trace_contracts.json``. It imports jax and the package, so this
+package's ``__init__`` must never import it — the CLI loads it on
+demand, and findings share the suppression/baseline machinery here.
 """
 
 from __future__ import annotations

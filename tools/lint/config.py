@@ -55,6 +55,17 @@ class NamesConfig:
 
 
 @dataclass(frozen=True)
+class TraceConfig:
+    """Trace-stage (``--trace``) inputs: the entry-point registry module
+    (imported by file path — the one module of the linter that DOES
+    import jax and the package, so it is loaded only on demand) and the
+    committed contract file the audit gates against."""
+
+    registry_path: str = "tools/lint/trace/registry.py"
+    contract_path: str = "tools/trace_contracts.json"
+
+
+@dataclass(frozen=True)
 class LintConfig:
     repo_root: str
     # files/dirs (repo-relative) the checkers scan by default
@@ -65,6 +76,7 @@ class LintConfig:
     faults: Optional[FaultConfig]
     names: Optional[NamesConfig]
     baseline_path: Optional[str] = None
+    trace: Optional[TraceConfig] = None
 
 
 # the host-side observability/resilience layer: imported from loader
@@ -150,4 +162,5 @@ def default_config(repo_root: str) -> LintConfig:
             doc_section="## 9.",
         ),
         baseline_path="tools/lint_baseline.json",
+        trace=TraceConfig(),
     )

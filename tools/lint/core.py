@@ -146,13 +146,18 @@ def load_baseline(path: Optional[str]) -> Dict[str, str]:
 
 def run_lint(config, paths: Optional[Sequence[str]] = None,
              checkers: Optional[Sequence[str]] = None,
-             full: Optional[bool] = None) -> LintResult:
+             full: Optional[bool] = None,
+             extra_findings: Optional[Sequence[Finding]] = None) -> LintResult:
     """Run the selected checkers (default: all configured) over ``paths``
     (default: the config's scan roots) and fold in suppressions and the
     baseline. ``full`` controls the registry-completeness directions
     (DTL032/033/042) — default: on exactly when scanning the full
     roots; fixture tests scanning explicit paths against their own
-    miniature registries pass ``full=True``."""
+    miniature registries pass ``full=True``. ``extra_findings`` are
+    pre-computed findings from another stage (the ``--trace`` jaxpr
+    audit) merged in BEFORE suppression/baseline processing, so both
+    stages share one suppression syntax, one baseline file, and one
+    exit code."""
     from . import fault_sites, layering, locks, names, purity
 
     registry = {
@@ -188,6 +193,8 @@ def run_lint(config, paths: Optional[Sequence[str]] = None,
     raw: List[Finding] = []
     for name in selected:
         raw.extend(registry[name](files, config, full=full))
+    if extra_findings:
+        raw.extend(extra_findings)
     raw.sort(key=lambda f: (f.path, f.line, f.code, f.anchor))
     # Uniquify colliding keys deterministically (source order): two `if`s
     # on traced values in one function share the anchor `fn:If`, and a
@@ -207,6 +214,19 @@ def run_lint(config, paths: Optional[Sequence[str]] = None,
     raw = uniq
 
     by_path = {f.path: f for f in files}
+    if extra_findings:
+        # trace-stage findings can anchor in files outside the AST scan
+        # paths (a narrowed scan still audits every registered entry
+        # point) — load those files on demand so their inline
+        # `# dtl: disable=` suppressions keep working
+        for f in extra_findings:
+            if f.path not in by_path and f.path.endswith(".py"):
+                try:
+                    loaded = load_files(config.repo_root, [f.path])
+                except (OSError, SyntaxError):
+                    continue
+                if loaded:
+                    by_path[f.path] = loaded[0]
     baseline = load_baseline(
         None if config.baseline_path is None
         else os.path.join(config.repo_root, config.baseline_path)
@@ -225,8 +245,23 @@ def run_lint(config, paths: Optional[Sequence[str]] = None,
         else:
             live.append(f)
     # staleness is only judgeable over the full scan roots — on a
-    # narrowed path list, entries for unscanned files are merely unseen
-    stale = sorted(set(baseline) - matched_keys) if full else []
+    # narrowed path list, entries for unscanned files are merely unseen.
+    # Same logic for STAGES: a DTL1xx (trace-stage) baseline key can only
+    # match when the trace stage ran (extra_findings is not None — an
+    # empty list still means "ran, found nothing"), so an AST-only scan
+    # must treat it as unseen, not stale, or a legitimately baselined
+    # trace finding would fail every plain `--check` run.
+    def judgeable(key: str) -> bool:
+        parts = key.split("::")
+        code = parts[1] if len(parts) > 1 else ""
+        if code.startswith("DTL1"):
+            return extra_findings is not None
+        return True
+
+    stale = (
+        sorted(k for k in set(baseline) - matched_keys if judgeable(k))
+        if full else []
+    )
     return LintResult(
         findings=live, suppressed=suppressed, baselined=baselined,
         stale_baseline=stale,

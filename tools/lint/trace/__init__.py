@@ -1,0 +1,69 @@
+"""dalle-tpu-lint, stage 2: trace-level program audit (``--trace``).
+
+The AST stage (``tools/lint/``, DTL0xx) checks what the *source* says;
+this stage checks what XLA actually gets. Every registered jit entry
+point (``registry.py``: the four serving jits, ``make_train_step``,
+``generate_image_tokens``) is traced to a ClosedJaxpr over abstract
+avals — ``jax.eval_shape``/``jax.make_jaxpr`` on CPU, no device
+execution, no compilation — and audited against a committed contract
+file (``tools/trace_contracts.json``).
+
+Finding codes (docs/DESIGN.md §11):
+
+=========  ==================================================================
+DTL101     a registered entry point has no contract entry (uncommitted)
+DTL102     a contract entry matches no registered entry point (stale —
+           fails ``--check`` until pruned, like a stale baseline key)
+DTL111     the registry derives a compile signature the contract does not
+           list — an unlisted signature is a runtime recompile (the
+           shape-drift bug class); steady-state ``_decode_jit`` is
+           contracted to EXACTLY one signature
+DTL112     the contract lists a signature the registry no longer produces
+           (stale signature entry)
+DTL113     distinct signature count exceeds the entry's budget
+DTL121     donation drift: a declared donated arg is not donated in the
+           traced program, or the program donates an undeclared arg
+DTL122     a donated buffer is not actually aliased input→output in the
+           lowered computation (``tf.aliasing_output``) — the donation
+           frees nothing and still invalidates the caller's array
+DTL131     host-callback eqns (``io_callback``/``pure_callback``/
+           ``debug_callback``) exceed the entry's budget
+DTL132     host-visible (non-donation-aliased) outputs exceed the entry's
+           readback budget — the decode hot loop is contracted to at most
+           ONE readback per iteration (the PR 5 lookahead seam)
+DTL141     static HBM footprint (argument + output − donated-alias aval
+           bytes) exceeds the entry's byte budget — live state silently
+           grew
+=========  ==================================================================
+
+Unlike the AST stage this package imports jax AND the package under
+audit — ``tools/lint/__init__.py`` must never import it; ``tools/
+lint.py`` loads it only under ``--trace``. Findings flow through the
+same suppression/baseline machinery and compose with the AST stage in
+one exit code. ``--emit-contract`` regenerates the contract from the
+current registry (the blessed-update workflow after an intentional
+change).
+"""
+
+from __future__ import annotations
+
+from .audit import (
+    audit_entry,
+    check_reports,
+    emit_contract,
+    load_contract,
+    run_trace,
+    trace_reports_only,
+)
+from .types import EntryPoint, Signature
+
+__all__ = [
+    "EntryPoint",
+    "Signature",
+    "audit_entry",
+    "check_reports",
+    "emit_contract",
+    "load_contract",
+    "run_trace",
+    "trace_reports_only",
+]

@@ -1,0 +1,523 @@
+"""The trace-stage auditor: abstract tracing + contract checking.
+
+For every registered :class:`~.types.EntryPoint` signature this module
+
+* traces the call to a ClosedJaxpr with ``jax.make_jaxpr`` over abstract
+  avals (``jax.ShapeDtypeStruct``) — CPU-safe, no device execution, no
+  compilation — and
+* (for jitted targets) lowers it with ``fn.lower(...)`` to read the
+  donation flags (``Lowered.args_info``) and the input→output buffer
+  aliasing XLA was actually handed (``tf.aliasing_output`` markers in
+  the StableHLO module text).
+
+The per-signature facts (signature key, callback equation counts,
+host-visible outputs, argument/output/aliased byte totals) are folded
+into one report per entry point and checked against the committed
+contract file (``tools/trace_contracts.json``), yielding DTL1xx
+findings (see ``tools/lint/trace/__init__.py`` for the code table).
+
+``emit_contract`` regenerates the contract JSON from the current
+registry — the blessed-update workflow after an intentional change, the
+same shape as re-baselining the AST stage.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core import Finding
+from .types import EntryPoint, Signature
+
+# primitives whose presence in a hot-loop jaxpr means a host round-trip
+# (io_callback / pure_callback / debug_callback a.k.a. jax.debug.print);
+# matched by name so new callback flavors fail loud rather than slip by
+_CALLBACK_NAME_FRAGMENT = "callback"
+_CALLBACK_EXTRA = {"debug_print"}
+
+_DTYPE_BYTES = {
+    "f64": 8, "i64": 8, "ui64": 8, "c64": 8,
+    "f32": 4, "i32": 4, "ui32": 4,
+    "f16": 2, "bf16": 2, "i16": 2, "ui16": 2,
+    "i8": 1, "ui8": 1, "i1": 1,
+    "f8E4M3FN": 1, "f8E5M2": 1, "f8E4M3B11FNUZ": 1,
+}
+
+
+# --------------------------------------------------------------- tracing
+
+
+def _aval_bytes(aval) -> int:
+    """Byte size of one aval; extended dtypes (PRNG keys) report their
+    true itemsize (a fry key is 2x uint32 = 8 bytes)."""
+    n = 1
+    for d in aval.shape:
+        n *= int(d)
+    return n * int(aval.dtype.itemsize)
+
+
+def _leaf_token(leaf) -> str:
+    if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype"):
+        # python scalar (e.g. a traced temperature float): abstract it the
+        # way jit would
+        import jax
+
+        leaf = jax.eval_shape(lambda x: x, leaf)
+    shape = "x".join(str(int(d)) for d in leaf.shape)
+    return f"{leaf.dtype}[{shape}]"
+
+
+def _sig_key(ep: EntryPoint, sig: Signature) -> str:
+    """Deterministic identity of one call signature: per-argument tokens
+    joined with ``|``. Static args contribute their repr (hashed when
+    long), single arrays their aval, pytrees a content hash plus leaf
+    and byte counts — compact enough for a committed contract file,
+    exact enough that any shape/dtype/static drift changes the key."""
+    import jax
+
+    tokens: List[str] = []
+    for i, arg in enumerate(sig.args):
+        if i in ep.static_argnums:
+            r = repr(arg)
+            tokens.append(
+                f"s:{r}" if len(r) <= 24
+                else "s:#" + hashlib.sha1(r.encode()).hexdigest()[:10]
+            )
+            continue
+        leaves = jax.tree_util.tree_leaves(arg)
+        if len(leaves) == 1 and leaves[0] is arg:
+            tokens.append(_leaf_token(arg))
+        else:
+            joined = ";".join(_leaf_token(x) for x in leaves)
+            digest = hashlib.sha1(joined.encode()).hexdigest()[:10]
+            nbytes = sum(_aval_bytes(x) for x in leaves)
+            tokens.append(f"tree#{digest}({len(leaves)}L,{nbytes}B)")
+    return "|".join(tokens)
+
+
+def _iter_subjaxprs(v):
+    """Duck-typed jaxpr discovery inside eqn params (works across jax
+    versions without importing private core modules): a ClosedJaxpr has
+    ``.jaxpr``, a raw Jaxpr has ``.eqns``."""
+    if hasattr(v, "jaxpr") and hasattr(getattr(v, "jaxpr"), "eqns"):
+        yield v.jaxpr
+    elif hasattr(v, "eqns"):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            yield from _iter_subjaxprs(x)
+
+
+def _count_callbacks(jaxpr, out: Dict[str, int]) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if _CALLBACK_NAME_FRAGMENT in name or name in _CALLBACK_EXTRA:
+            out[name] = out.get(name, 0) + 1
+        for v in eqn.params.values():
+            for sub in _iter_subjaxprs(v):
+                _count_callbacks(sub, out)
+
+
+def _tensor_bytes(tensor_type: str) -> int:
+    """Bytes of an MLIR ``tensor<2x5xf32>`` type string (``tensor<f32>``
+    is a scalar). Unknown element types count as 0 — HBM accounting
+    degrades, the gate never crashes on an exotic dtype."""
+    inner = tensor_type[len("tensor<"):-1]
+    parts = inner.split("x")
+    dims, elem = [], parts[-1]
+    for p in parts[:-1]:
+        dims.append(int(p))
+    n = 1
+    for d in dims:
+        n *= d
+    return n * _DTYPE_BYTES.get(elem, 0)
+
+
+_ARG_RE = re.compile(r"%arg(\d+): (tensor<[^>]*>)")
+_ALIAS_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+
+
+def _parse_main_aliasing(text: str) -> List[Tuple[int, str, Optional[int]]]:
+    """Per-argument (index, tensor type, aliased-output-index-or-None)
+    parsed from the ``@main(...)`` signature of the lowered module text.
+    Segments between ``%argN:`` tokens carry each argument's attribute
+    dict; quotes inside attributes cannot contain ``%arg``, so token
+    splitting is unambiguous."""
+    start = text.find("@main(")
+    if start < 0:
+        return []
+    i = start + len("@main(")
+    depth = 1
+    j = i
+    while j < len(text) and depth:
+        c = text[j]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+        j += 1
+    region = text[i:j - 1]
+    matches = list(_ARG_RE.finditer(region))
+    out: List[Tuple[int, str, Optional[int]]] = []
+    for k, m in enumerate(matches):
+        seg_end = matches[k + 1].start() if k + 1 < len(matches) else len(region)
+        segment = region[m.start():seg_end]
+        alias = _ALIAS_RE.search(segment)
+        out.append((
+            int(m.group(1)), m.group(2),
+            int(alias.group(1)) if alias else None,
+        ))
+    return out
+
+
+def audit_entry(ep: EntryPoint) -> Dict[str, Any]:
+    """Trace every declared signature of one entry point. Returns the
+    per-entry report the checkers (and ``--emit-contract``) consume."""
+    import jax
+
+    sig_reports: List[Dict[str, Any]] = []
+    donated_argnums: List[int] = []
+    donated_leaves = 0
+    alias_markers = 0
+    aliased_outputs = 0
+    aliased_bytes = 0
+    lowered_checked = False
+
+    for si, sig in enumerate(ep.signatures):
+        jaxpr = jax.make_jaxpr(
+            ep.fn, static_argnums=ep.static_argnums or ()
+        )(*sig.args)
+        callbacks: Dict[str, int] = {}
+        _count_callbacks(jaxpr.jaxpr, callbacks)
+        in_bytes = sum(_aval_bytes(a) for a in jaxpr.in_avals)
+        out_bytes = sum(_aval_bytes(a) for a in jaxpr.out_avals)
+        n_out = len(jaxpr.out_avals)
+
+        sig_aliased_out = 0
+        sig_aliased_bytes = 0
+        if ep.lower is not None and si == 0:
+            # donation structure is signature-independent (same code
+            # path, same donate_argnums) — lower once, on the first
+            lowered = ep.lower(*sig.args)
+            info_args = lowered.args_info[0]
+            for pos, arg_info in enumerate(info_args):
+                flags = [
+                    bool(getattr(x, "donated", False))
+                    for x in jax.tree_util.tree_leaves(arg_info)
+                ]
+                if any(flags):
+                    # map dynamic position back to the original argnum
+                    orig = pos
+                    for s in sorted(ep.static_argnums or ()):
+                        if s <= orig:
+                            orig += 1
+                    donated_argnums.append(orig)
+                    donated_leaves += sum(flags)
+            args = _parse_main_aliasing(lowered.as_text())
+            marker_outputs = set()
+            for _idx, ttype, alias in args:
+                if alias is not None:
+                    alias_markers += 1
+                    marker_outputs.add(alias)
+                    sig_aliased_bytes += _tensor_bytes(ttype)
+            sig_aliased_out = len(marker_outputs)
+            lowered_checked = True
+        elif lowered_checked:
+            # other signatures alias the same way; reuse sig 0's totals
+            sig_aliased_out = aliased_outputs
+            sig_aliased_bytes = aliased_bytes
+        if si == 0:
+            aliased_outputs = sig_aliased_out
+            aliased_bytes = sig_aliased_bytes
+
+        sig_reports.append({
+            "label": sig.label,
+            "key": _sig_key(ep, sig),
+            "callbacks": callbacks,
+            "n_callbacks": sum(callbacks.values()),
+            "n_outputs": n_out,
+            "host_visible_outputs": n_out - sig_aliased_out,
+            "arg_bytes": in_bytes,
+            "out_bytes": out_bytes,
+            "aliased_bytes": sig_aliased_bytes,
+            "hbm_bytes": in_bytes + out_bytes - sig_aliased_bytes,
+        })
+
+    return {
+        "name": ep.name,
+        "path": ep.path,
+        "symbol": ep.symbol,
+        "declared_donate": dict(ep.donate),
+        "lowered": ep.lower is not None,
+        "donated_argnums": sorted(set(donated_argnums)),
+        "donated_leaves": donated_leaves,
+        "alias_markers": alias_markers,
+        "signatures": sig_reports,
+        "max_callbacks": max(s["n_callbacks"] for s in sig_reports),
+        "max_host_visible_outputs": max(
+            s["host_visible_outputs"] for s in sig_reports
+        ),
+        "max_hbm_bytes": max(s["hbm_bytes"] for s in sig_reports),
+    }
+
+
+# ---------------------------------------------------------- the contract
+
+
+def load_contract(path: str) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(
+            f"trace contract {path}: want a JSON object with an "
+            f'"entries" map, got {type(data).__name__}'
+        )
+    return data
+
+
+def emit_contract(reports: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Contract JSON derived from the current registry + trace — commit
+    the output after an INTENTIONAL change (new signature, bigger live
+    state), exactly like re-baselining."""
+    entries: Dict[str, Any] = {}
+    for r in sorted(reports, key=lambda r: r["name"]):
+        entries[r["name"]] = {
+            "path": r["path"],
+            "max_signatures": len(r["signatures"]),
+            "signatures": [
+                {"label": s["label"], "key": s["key"]}
+                for s in r["signatures"]
+            ],
+            "donate": sorted(r["declared_donate"]),
+            "max_host_callbacks": r["max_callbacks"],
+            "max_host_visible_outputs": r["max_host_visible_outputs"],
+            "max_hbm_bytes": r["max_hbm_bytes"],
+        }
+    return {"version": 1, "entries": entries}
+
+
+def _def_line(repo_root: str, rel_path: str, symbol: str) -> int:
+    """Line of ``def <symbol>`` in the entry's source file (1 if the
+    file or def is missing — the finding still renders)."""
+    path = os.path.join(repo_root, rel_path)
+    try:
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                if re.match(rf"\s*def {re.escape(symbol)}\b", line):
+                    return i
+    except OSError:
+        pass
+    return 1
+
+
+def check_reports(
+    reports: List[Dict[str, Any]],
+    contract: Dict[str, Any],
+    contract_path: str,
+    repo_root: str,
+) -> List[Finding]:
+    """Compare audit reports against the committed contract; every
+    divergence is a DTL1xx finding anchored on the entry point."""
+    findings: List[Finding] = []
+    entries = contract.get("entries", {})
+    by_name = {r["name"]: r for r in reports}
+
+    def add(code, rep, msg, anchor_suffix="", path=None, line=None):
+        rel = path if path is not None else rep["path"]
+        findings.append(Finding(
+            code=code,
+            path=rel,
+            line=line if line is not None
+            else _def_line(repo_root, rel, rep["symbol"]),
+            message=msg,
+            anchor=rep["name"] + anchor_suffix,
+        ))
+
+    for name in sorted(set(entries) - set(by_name)):
+        findings.append(Finding(
+            code="DTL102", path=contract_path, line=1,
+            message=f"contract entry '{name}' matches no registered "
+                    f"trace entry point — prune it (the contract, like "
+                    f"the baseline, can only track live code)",
+            anchor=name,
+        ))
+
+    for rep in reports:
+        name = rep["name"]
+        c = entries.get(name)
+        if c is None:
+            add("DTL101", rep,
+                f"entry point '{name}' has no committed contract entry — "
+                f"run `python tools/lint.py --trace --emit-contract` and "
+                f"review the diff")
+            continue
+
+        # ---- DTL11x: compile-signature budget -------------------------
+        listed = {s["key"]: s.get("label", "") for s in c.get("signatures", [])}
+        produced = {s["key"]: s["label"] for s in rep["signatures"]}
+        for key, label in sorted(produced.items()):
+            if key not in listed:
+                add("DTL111", rep,
+                    f"'{name}' can be fed signature [{label}] {key} that "
+                    f"the contract does not list — an unlisted signature "
+                    f"is a recompile the serving/train loop would eat at "
+                    f"runtime", anchor_suffix=f":{label}")
+        for key, label in sorted(listed.items()):
+            if key not in produced:
+                add("DTL112", rep,
+                    f"contract lists signature [{label}] {key} for "
+                    f"'{name}' that the registry no longer produces — "
+                    f"stale contract entries must be pruned",
+                    anchor_suffix=f":{key[:24]}")
+        max_sigs = c.get("max_signatures")
+        if max_sigs is not None and len(produced) > max_sigs:
+            add("DTL113", rep,
+                f"'{name}' is fed {len(produced)} distinct compile "
+                f"signatures, contract budget is {max_sigs} — every "
+                f"extra signature is a steady-state recompile")
+
+        # ---- DTL12x: donation audit -----------------------------------
+        declared = set(c.get("donate", []))
+        registry_declared = rep["declared_donate"]
+        for arg in sorted(declared - set(registry_declared)):
+            add("DTL121", rep,
+                f"contract declares donated arg '{arg}' for '{name}' but "
+                f"the registry maps no such argument — fix the contract "
+                f"or the registry entry", anchor_suffix=f":{arg}")
+        if rep["lowered"]:
+            actual = set(rep["donated_argnums"])
+            for arg in sorted(declared & set(registry_declared)):
+                if registry_declared[arg] not in actual:
+                    add("DTL121", rep,
+                        f"'{name}' declares donation of '{arg}' (arg "
+                        f"{registry_declared[arg]}) but the traced "
+                        f"program does not donate it — the buffer is "
+                        f"double-buffered in HBM for every call",
+                        anchor_suffix=f":{arg}")
+            declared_nums = {
+                registry_declared[a] for a in declared
+                if a in registry_declared
+            }
+            undeclared = actual - declared_nums
+            if undeclared:
+                add("DTL121", rep,
+                    f"'{name}' donates arg(s) {sorted(undeclared)} the "
+                    f"contract does not declare — donation is a caller "
+                    f"contract (the passed buffer dies) and must be "
+                    f"committed, not implicit", anchor_suffix=":undeclared")
+            if rep["donated_leaves"] > rep["alias_markers"]:
+                add("DTL122", rep,
+                    f"'{name}' donates {rep['donated_leaves']} buffers "
+                    f"but only {rep['alias_markers']} are aliased "
+                    f"input→output in the lowered computation — the "
+                    f"unaliased donations free nothing and still "
+                    f"invalidate the caller's arrays")
+        elif declared:
+            add("DTL122", rep,
+                f"'{name}' declares donated args {sorted(declared)} but "
+                f"is not a jitted target — nothing can alias")
+
+        # ---- DTL13x: host-sync / readback audit -----------------------
+        max_cb = c.get("max_host_callbacks")
+        if max_cb is not None and rep["max_callbacks"] > max_cb:
+            per = {
+                k: v for s in rep["signatures"]
+                for k, v in s["callbacks"].items()
+            }
+            add("DTL131", rep,
+                f"'{name}' contains {rep['max_callbacks']} host-callback "
+                f"eqn(s) {per}, budget {max_cb} — each is a device→host "
+                f"round-trip inside a hot-loop jit")
+        max_vis = c.get("max_host_visible_outputs")
+        if max_vis is not None and rep["max_host_visible_outputs"] > max_vis:
+            add("DTL132", rep,
+                f"'{name}' exposes {rep['max_host_visible_outputs']} "
+                f"host-visible (non-donation-aliased) outputs, budget "
+                f"{max_vis} — the per-iteration readback contract "
+                f"(one decode step = at most one host read) is broken")
+
+        # ---- DTL14x: static HBM footprint -----------------------------
+        max_hbm = c.get("max_hbm_bytes")
+        if max_hbm is not None and rep["max_hbm_bytes"] > max_hbm:
+            worst = max(rep["signatures"], key=lambda s: s["hbm_bytes"])
+            add("DTL141", rep,
+                f"'{name}' static HBM footprint {rep['max_hbm_bytes']}B "
+                f"(args {worst['arg_bytes']}B + outputs "
+                f"{worst['out_bytes']}B - aliased "
+                f"{worst['aliased_bytes']}B) exceeds the contract budget "
+                f"{max_hbm}B — live state grew; if intentional, re-emit "
+                f"the contract")
+
+    return findings
+
+
+# ------------------------------------------------------------ the runner
+
+
+def _load_registry(repo_root: str, registry_path: str):
+    """Import a registry module by file path (the repo's or a fixture's).
+    The repo root goes on sys.path first so the registry can import the
+    package it audits."""
+    ab = (registry_path if os.path.isabs(registry_path)
+          else os.path.join(repo_root, registry_path))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    # registries import `lint.trace.types` absolutely (they are loaded by
+    # file path, without a parent package) — make the lint package root
+    # importable regardless of how we were invoked
+    tools_dir = os.path.join(repo_root, "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    name = "_dalle_trace_registry_" + hashlib.sha1(
+        ab.encode()
+    ).hexdigest()[:8]
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, ab)
+    if spec is None or spec.loader is None:
+        raise OSError(f"cannot load trace registry {ab}")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    if not hasattr(mod, "build_entry_points"):
+        raise ValueError(
+            f"trace registry {registry_path} must define "
+            f"build_entry_points() -> list[EntryPoint]"
+        )
+    return mod
+
+
+def run_trace(
+    repo_root: str,
+    registry_path: str,
+    contract_path: str,
+) -> Tuple[List[Finding], List[Dict[str, Any]]]:
+    """The ``--trace`` stage: load the registry, audit every entry,
+    check against the contract. Returns (findings, reports); findings
+    feed the shared suppression/baseline machinery in ``core.run_lint``."""
+    mod = _load_registry(repo_root, registry_path)
+    eps: List[EntryPoint] = mod.build_entry_points()
+    reports = [audit_entry(ep) for ep in eps]
+    ab_contract = (contract_path if os.path.isabs(contract_path)
+                   else os.path.join(repo_root, contract_path))
+    if not os.path.exists(ab_contract):
+        raise OSError(
+            f"trace contract file {contract_path} not found — generate "
+            f"it with `python tools/lint.py --trace --emit-contract > "
+            f"{contract_path}`"
+        )
+    contract = load_contract(ab_contract)
+    rel_contract = contract_path.replace(os.sep, "/")
+    findings = check_reports(reports, contract, rel_contract, repo_root)
+    return findings, reports
+
+
+def trace_reports_only(repo_root: str, registry_path: str):
+    """Audit without a contract (``--emit-contract`` path)."""
+    mod = _load_registry(repo_root, registry_path)
+    return [audit_entry(ep) for ep in mod.build_entry_points()]

@@ -1,0 +1,262 @@
+"""The repo's trace-audit entry points.
+
+This module — unlike the AST stage — IMPORTS the package, because its
+job is to enumerate the (shape, dtype, static-arg) signatures the real
+code paths can feed each hot jit. Everything is derived from the same
+objects production uses:
+
+* the serving jits' signatures come from ``EngineConfig``/model config
+  exactly the way ``serving/engine.py`` computes them (chunk widths via
+  the engine's own ``_next_chunk``, the top-k ``k`` via the engine's
+  formula, cache avals via ``init_decode_cache``/``set_decode_offsets``
+  under ``jax.eval_shape``),
+* the train entry builds a real ``make_train_step`` (donated state,
+  NaN guard on) over a single-device mesh,
+* the sampling entry traces ``generate_image_tokens`` end to end.
+
+All avals are abstract (``jax.eval_shape`` — no device execution, no
+compilation), over a CANONICAL small config: byte budgets in the
+contract are for this config, and what the audit guards is the *shape*
+of the program (signature count, donation aliasing, readbacks, relative
+footprint), which is config-independent. Changing the canonical config
+is an intentional contract change — re-emit with
+``python tools/lint.py --trace --emit-contract``.
+
+Adding an entry point: build its abstract args here, declare its donated
+args, list every signature the surrounding code can produce, and append
+an ``EntryPoint``; then re-emit the contract and commit both (see
+docs/DESIGN.md §11).
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import List
+
+# absolute import: this module is loaded by FILE PATH (audit._load_registry,
+# same mechanism fixture registries use), so it has no parent package
+from lint.trace.types import EntryPoint, Signature
+
+# the canonical audit model: tiny (trace cost, not fidelity, scales with
+# size) but structurally the production shape — rotary, full attention,
+# the same layer stack the serving gates drive (tools/serve_smoke.py)
+CANON_MODEL = dict(
+    dim=32, depth=2, num_text_tokens=16, text_seq_len=4,
+    num_image_tokens=12, image_fmap_size=2, heads=2, dim_head=8,
+    attn_types=("full",), rotary_emb=True,
+)
+# the canonical engine: chunked prefill on, the production serving shape
+CANON_ENGINE = dict(max_batch=2, prefill_chunk=2)
+
+
+def build_entry_points() -> List[EntryPoint]:
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    # Pin the KV page size for this PROCESS (aval derivation here AND the
+    # audit traces that follow): tests override DALLE_TPU_KV_PAGE_SIZE to
+    # exercise page-boundary arithmetic on tiny models, and the smoke
+    # gates' lint pre-flight subprocesses inherit that env — but the
+    # committed contract describes the canonical program, so its cache
+    # shapes must not drift with the caller's environment.
+    from dalle_pytorch_tpu.ops.kv_policy import DEFAULT_PAGE_SIZE
+
+    os.environ["DALLE_TPU_KV_PAGE_SIZE"] = str(DEFAULT_PAGE_SIZE)
+
+    from dalle_pytorch_tpu.models import DALLE
+    from dalle_pytorch_tpu.models.sampling import (
+        generate_image_tokens,
+        init_decode_cache,
+        set_decode_offsets,
+    )
+    from dalle_pytorch_tpu.serving import engine as eng
+    from dalle_pytorch_tpu.serving.engine import Engine, EngineConfig
+
+    SDS = jax.ShapeDtypeStruct
+    dalle = DALLE(**CANON_MODEL)
+    cfg = EngineConfig(**CANON_ENGINE)
+    B = cfg.max_batch
+    T = dalle.text_len_internal
+
+    text1 = SDS((1, dalle.text_seq_len), jnp.int32)
+    image1 = SDS((1, dalle.image_seq_len), jnp.int32)
+    params = jax.eval_shape(
+        lambda t, i: dalle.init(jax.random.key(0), t, i), text1, image1
+    )["params"]
+    internal = jax.eval_shape(dalle.remap_text, text1)  # (1, T) with bos
+
+    def cache_avals(b):
+        def build(p):
+            return set_decode_offsets(
+                init_decode_cache(dalle, p, b, cache_format="paged"),
+                jnp.zeros((b,), jnp.int32),
+            )
+        return jax.eval_shape(build, params)
+
+    cache1 = cache_avals(1)
+    cacheB = cache_avals(B)
+    key = jax.eval_shape(lambda: jax.random.key(0))
+    keysB = jax.eval_shape(lambda: jnp.stack([jax.random.key(0)] * B))
+    # the engine's own top-k formula (Engine.__init__: full-vocab-derived
+    # fractional k over the image-only head)
+    k_img = max(int((1 - cfg.filter_thres) * dalle.total_tokens), 1)
+    i32 = SDS((), jnp.int32)
+
+    # chunk widths exactly as the engine schedules them: simulate the
+    # REAL Engine._next_chunk (1-token tails merged) over (T, chunk)
+    shim = SimpleNamespace(config=cfg, T=T)
+    widths, filled = [], 0
+    while filled < T:
+        c = Engine._next_chunk(shim, filled)
+        widths.append((c, filled + c >= T))
+        filled += c
+    chunk_widths = sorted({c for c, final in widths if not final})
+    final_widths = sorted({c for c, final in widths if final})
+
+    entries = [
+        EntryPoint(
+            name="serving.prefill",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_prefill_jit",
+            fn=eng._prefill_jit,
+            lower=eng._prefill_jit.lower,
+            static_argnums=(0, 5),
+            donate={"cache": 2},
+            signatures=[Signature(
+                "monolithic",
+                (dalle, params, cache1, internal, key, k_img, 1.0),
+            )],
+        ),
+        EntryPoint(
+            name="serving.prefill_chunk",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_prefill_chunk_jit",
+            fn=eng._prefill_chunk_jit,
+            lower=eng._prefill_chunk_jit.lower,
+            static_argnums=(0,),
+            donate={"cache": 2},
+            signatures=[
+                Signature(
+                    f"chunk_w{c}",
+                    (dalle, params, cache1, SDS((1, c), jnp.int32), i32),
+                )
+                for c in chunk_widths
+            ],
+        ),
+        EntryPoint(
+            name="serving.prefill_last",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_prefill_last_jit",
+            fn=eng._prefill_last_jit,
+            lower=eng._prefill_last_jit.lower,
+            static_argnums=(0, 5),
+            donate={"cache": 2},
+            signatures=[
+                Signature(
+                    f"final_w{c}",
+                    (dalle, params, cache1, SDS((1, c), jnp.int32), i32,
+                     k_img, key, 1.0),
+                )
+                for c in final_widths
+            ],
+        ),
+        EntryPoint(
+            name="serving.decode",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_decode_jit",
+            fn=eng._decode_jit,
+            lower=eng._decode_jit.lower,
+            static_argnums=(0, 6),
+            donate={"cache": 2},
+            # steady state is EXACTLY one signature: the engine always
+            # dispatches the full max_batch width with vectorized
+            # positions/keys — any second signature here is the
+            # batch-shape recompile bug class this audit exists to catch
+            signatures=[Signature(
+                "steady",
+                (dalle, params, cacheB, SDS((B,), jnp.int32),
+                 SDS((B,), jnp.int32), keysB, k_img, 1.0),
+            )],
+        ),
+        _train_entry(dalle, B),
+        EntryPoint(
+            name="sampling.generate",
+            path="dalle_pytorch_tpu/models/sampling.py",
+            symbol="generate_image_tokens",
+            fn=lambda p, t, k: generate_image_tokens(dalle, p, t, k),
+            lower=None,
+            static_argnums=(),
+            donate={},
+            signatures=[Signature(
+                "batch1", (params, text1, key),
+            )],
+        ),
+    ]
+    return entries
+
+
+def _train_entry(dalle, batch: int) -> EntryPoint:
+    """A real ``make_train_step`` (donate=True, nan_guard=True) over a
+    single-device mesh, with the canonical model's own weighted-CE loss
+    — auditing the builder everything in train_dalle.py runs through."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dalle_pytorch_tpu.parallel.mesh import make_runtime
+    from dalle_pytorch_tpu.parallel.sharding import (
+        opt_state_shardings,
+        params_shardings,
+    )
+    from dalle_pytorch_tpu.parallel.step import (
+        TrainState,
+        make_train_step,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    SDS = jax.ShapeDtypeStruct
+    # ONE device, always: the audit must derive the same signatures and
+    # byte budgets on a laptop, under the test suite's 8-fake-device
+    # XLA_FLAGS, and on a real pod — the contract is about the program,
+    # not the host it was traced on
+    runtime = make_runtime(devices=jax.devices()[:1])
+    optimizer = optax.adam(1e-3)
+
+    def loss_fn(params, batch, rng):
+        text, image = batch
+        return dalle.apply({"params": params}, text, image, return_loss=True)
+
+    text = SDS((batch, dalle.text_seq_len), jnp.int32)
+    image = SDS((batch, dalle.image_seq_len), jnp.int32)
+    params = jax.eval_shape(
+        lambda t, i: dalle.init(jax.random.key(0), t, i), text, image
+    )["params"]
+    opt_state = jax.eval_shape(optimizer.init, params)
+    i32 = SDS((), jnp.int32)
+    state = TrainState(
+        step=i32, params=params, opt_state=opt_state,
+        skipped=i32, consec_skipped=i32,
+    )
+    p_shard = params_shardings(params, runtime.mesh)
+    replicated = NamedSharding(runtime.mesh, P())
+    shardings = TrainState(
+        step=replicated, params=p_shard,
+        opt_state=opt_state_shardings(opt_state, p_shard, runtime.mesh),
+        skipped=replicated, consec_skipped=replicated,
+    )
+    train_step = make_train_step(
+        loss_fn, optimizer, runtime, shardings, donate=True
+    )
+    key = jax.eval_shape(lambda: jax.random.key(0))
+    return EntryPoint(
+        name="train.step",
+        path="dalle_pytorch_tpu/parallel/step.py",
+        symbol="make_train_step",
+        fn=train_step,
+        lower=train_step.lower,
+        static_argnums=(),
+        donate={"state": 0},
+        signatures=[Signature("step", (state, (text, image), key))],
+    )

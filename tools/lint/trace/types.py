@@ -1,0 +1,53 @@
+"""Trace-stage data types: entry-point registry records.
+
+Deliberately jax-free: a registry module (the repo's
+``tools/lint/trace/registry.py`` or a test fixture) imports these to
+DECLARE its entry points; the tracing itself lives in ``audit.py``.
+
+An :class:`EntryPoint` names one jitted program the production code
+dispatches on a hot path, the closed set of abstract call signatures the
+surrounding code can feed it, and the donation contract its source
+declares. ``audit.py`` traces each signature to a ClosedJaxpr (abstract
+avals only — no device execution) and checks the result against the
+committed contract file (``tools/trace_contracts.json``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Signature:
+    """One abstract call signature: the full positional argument tuple,
+    with dynamic arguments as ``jax.ShapeDtypeStruct`` pytrees and static
+    arguments (positions in ``EntryPoint.static_argnums``) as the
+    concrete values the caller passes."""
+
+    label: str
+    args: Tuple[Any, ...]
+
+
+@dataclass(frozen=True)
+class EntryPoint:
+    """One registered jit entry point.
+
+    ``fn`` is the callable to trace (usually the jitted function itself;
+    ``jax.make_jaxpr`` traces through it). ``lower`` is its ``.lower``
+    bound method when the target is jitted — the donation/aliasing audit
+    reads the lowered computation — or None for plain callables (which
+    then must declare no donation). ``donate`` maps the DECLARED donated
+    argument names to their positions in the signature; the audit
+    verifies the declaration against both the traced program
+    (``donated_invars``) and the lowered aliasing
+    (``tf.aliasing_output``)."""
+
+    name: str
+    path: str                       # repo-relative file (finding anchor)
+    symbol: str                     # def name, for line lookup
+    fn: Callable[..., Any]
+    signatures: Sequence[Signature]
+    static_argnums: Tuple[int, ...] = ()
+    donate: Dict[str, int] = field(default_factory=dict)
+    lower: Optional[Callable[..., Any]] = None

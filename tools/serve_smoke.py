@@ -49,23 +49,32 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def lint_preflight(label: str = "serve smoke") -> int:
-    """Static-analysis pre-flight (docs/DESIGN.md §11): run
-    ``tools/lint.py --check`` before any engine spins up, so a tree that
-    violates the machine-checked invariants (jit purity, import layers,
-    fault-site/telemetry-name registries, lock discipline) fails the
-    gate in milliseconds instead of mid-drill. Subprocess on purpose:
-    the linter is stdlib-only and must not inherit this process's jax
-    initialization."""
+    """Static-analysis pre-flight (docs/DESIGN.md §11), two stages in
+    escalation order: first the AST stage alone (``lint.py --check`` —
+    stdlib-only, so a corrupt tree still fails in milliseconds), then
+    the full composition with the TRACE stage (``lint.py --trace
+    --check``): every serving jit this gate is about to drive must
+    match its committed compile-signature/donation/readback/HBM
+    contract (tools/trace_contracts.json) BEFORE a request is admitted.
+    Subprocesses on purpose: the AST stage must not inherit this
+    process's jax initialization, and the trace stage re-imports the
+    package fresh so a broken import fails the gate, not the drill."""
     import subprocess
 
-    proc = subprocess.run(
-        [sys.executable, str(REPO / "tools" / "lint.py"), "--check"],
-        capture_output=True, text=True, cwd=REPO,
-    )
-    if proc.returncode != 0:
-        print(f"{label} FAILED: lint pre-flight found invariant "
-              f"violations:\n{proc.stdout}{proc.stderr}", file=sys.stderr)
-    return proc.returncode
+    for stage, args in (
+        ("lint", ["--check"]),
+        ("trace-lint", ["--trace", "--check"]),
+    ):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"), *args],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        if proc.returncode != 0:
+            print(f"{label} FAILED: {stage} pre-flight found invariant "
+                  f"violations:\n{proc.stdout}{proc.stderr}",
+                  file=sys.stderr)
+            return proc.returncode
+    return 0
 
 
 def build_tiny_model():
