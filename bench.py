@@ -98,6 +98,7 @@ def serving_jit_signatures() -> dict:
         "prefill_chunk": _engine._prefill_chunk_jit,
         "prefill_last": _engine._prefill_last_jit,
         "decode": _engine._decode_jit,
+        "iteration": _engine._iteration_jit,
         "decode_tokens": _sampling.decode_tokens,
     }
     out = {}
@@ -500,6 +501,222 @@ def bench_serve(on_cpu: bool, int8: bool = True, seed: int = 0):
                                   "+ per-jit _cache_size deltas "
                                   "(-1 = counter unavailable)",
         "mean_interarrival_s": mean_ia,
+        "arrival_seed": seed,
+        "max_batch": max_batch,
+        "device": jax.devices()[0].device_kind,
+    }
+
+
+def bench_serve_fused(on_cpu: bool, int8: bool | None = None, seed: int = 0,
+                      model=None):
+    """--serve companion: the unified ragged-iteration record (ROADMAP 1,
+    "Ragged Paged Attention"). One staggered arrival trace runs through
+    TWO chunked engines — SPLIT (one jit dispatch per prefill chunk plus
+    one per decode step) and FUSED (``_iteration_jit``: every granted
+    chunk plus the decode rows in ONE dispatch) — and the record reports
+    ``dispatches_per_iteration`` for both, the per-iteration dispatch
+    overhead the fusion removes, and wall/throughput for context. The
+    acceptance checks run IN-BENCH:
+
+      * the fused trace contains genuinely MIXED iterations (prefilling
+        and decoding slots coexist) and still never exceeds one dispatch
+        per iteration (``engine.dispatches <= engine.iterations`` — the
+        steady-state 1-dispatch contract, which DTL11x pins at the
+        compile-signature level);
+      * the fused timed trace performs ZERO jit recompiles and ZERO
+        backend compiles (the PR 8 compile listener + per-jit signature
+        deltas — descriptor raggedness is data, so no mix can drift the
+        signature);
+      * completed tokens are BIT-identical split vs fused for f32
+        models (the parity tier — the tiny-model smoke/test gates run
+        there). For the bf16 flagship the comparison is REPORTED, not
+        asserted: XLA fuses bf16 elementwise chains differently across
+        program shapes (the W-wide fused block vs the n=1 split step),
+        rounding some intermediates one bf16 ulp apart, and on TPU the
+        lane-packed split decode adds the same drift class — near-tie
+        tokens can legitimately flip.
+
+    ``int8`` defaults to bf16 on CPU (the same per-call head-dequant CPU
+    artifact bench_serve_interference documents); wall-clock comparisons
+    between the modes on CPU also carry the fused path's padded-row
+    compute, so the structural dispatch counts are the headline and the
+    times are context. ``model`` overrides the flagship serving model
+    (tests pass a tiny one)."""
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, Outcome, Request, check_accounting,
+    )
+
+    if int8 is None:
+        int8 = not on_cpu
+    if model is None:
+        dalle, params, _, fmap = _serving_model(on_cpu, int8)
+    else:
+        dalle, params = model
+        fmap = dalle.image_fmap_size
+    T = dalle.text_len_internal
+    chunk = max(2, T // 16)
+    n_req = 5 if on_cpu else 32
+    max_batch = 2 if on_cpu else 8
+    max_new = min(fmap * fmap, 6 if on_cpu else 48)
+    rng = np.random.RandomState(seed)
+    vocab = min(NUM_TEXT, dalle.num_text_tokens)
+    prompts = rng.randint(
+        1, vocab, size=(n_req, dalle.text_seq_len)
+    ).astype(np.int32)
+
+    def run_mode(fused: bool) -> dict:
+        engine = Engine(dalle, params, EngineConfig(
+            max_batch=max_batch, prefill_chunk=chunk, fused_iteration=fused,
+        ))
+        # warm every signature outside the timed trace (both slot indices
+        # see their first insert/reset; the fused mode's ONE signature
+        # covers chunks, final chunks and decode alike)
+        for i in range(2):
+            engine.submit(Request(
+                request_id=f"__warm{i}__",
+                prompt=np.zeros(dalle.text_seq_len, np.int32),
+                max_new_tokens=2, seed=0,
+            ))
+        engine.run()
+        sig0, bc0 = serving_jit_signatures(), backend_compiles()
+        d0, i0 = engine.dispatches, engine.iterations
+        mixed_iterations = 0
+        submitted = 0
+
+        def submit_next():
+            nonlocal submitted
+            engine.submit(Request(
+                request_id=f"req{submitted}", prompt=prompts[submitted],
+                max_new_tokens=max_new, seed=seed * 7919 + submitted,
+            ))
+            submitted += 1
+
+        t0 = time.perf_counter()
+        while True:
+            # staggered submits (by iteration count, not wall clock, so
+            # both modes see the same admission schedule): prefills keep
+            # arriving while earlier requests decode -> mixed iterations
+            while submitted < n_req and (
+                submitted == 0 or engine.iterations - i0 >= submitted * 2
+            ):
+                submit_next()
+            phases = {s.phase for s in engine.slots if s}
+            if len(phases) == 2:
+                mixed_iterations += 1
+            if not engine.step():
+                if submitted >= n_req:
+                    break
+                # idle with arrivals pending (iterations stop advancing
+                # when nothing works, so the gate alone would deadlock):
+                # release the next request now
+                submit_next()
+        wall = time.perf_counter() - t0
+        check_accounting(engine)
+        sig1, bc1 = serving_jit_signatures(), backend_compiles()
+        dispatches = engine.dispatches - d0
+        iterations = engine.iterations - i0
+        toks = {
+            r.request_id: np.asarray(r.tokens)
+            for r in engine.results.values()
+            if r.outcome is Outcome.COMPLETED
+            and not r.request_id.startswith("__warm")
+        }
+        assert len(toks) == n_req, (
+            f"{'fused' if fused else 'split'} trace completed "
+            f"{len(toks)}/{n_req}"
+        )
+        return {
+            "dispatches": dispatches,
+            "iterations": iterations,
+            "per_iter": dispatches / max(iterations, 1),
+            "wall": wall,
+            "tps": sum(len(t) for t in toks.values()) / wall,
+            "mixed_iterations": mixed_iterations,
+            "compiles_trace": bc1 - bc0 if bc0 >= 0 else -1,
+            "jit_recompiles_trace": _sig_delta(sig1, sig0),
+            "tokens": toks,
+        }
+
+    split = run_mode(fused=False)
+    fused = run_mode(fused=True)
+
+    # acceptance: mixed iterations, one dispatch per fused iteration, no
+    # in-trace compiles, bit-identical output
+    assert fused["mixed_iterations"] > 0, (
+        "fused trace never interleaved prefill with decode — the record "
+        "would not exercise the ragged mix"
+    )
+    assert fused["dispatches"] <= fused["iterations"], (
+        f"fused engine exceeded one dispatch per iteration: "
+        f"{fused['dispatches']} dispatches / {fused['iterations']} iterations"
+    )
+    assert split["dispatches"] > split["iterations"], (
+        "split trace never needed more than one dispatch per iteration — "
+        "the comparison is degenerate (no mixed prefill+decode pressure)"
+    )
+    assert fused["compiles_trace"] in (0, -1), (
+        f"fused timed trace compiled {fused['compiles_trace']} modules"
+    )
+    assert all(v in (0, -1) for v in fused["jit_recompiles_trace"].values()), (
+        f"fused timed trace recompiled serving jits: "
+        f"{fused['jit_recompiles_trace']}"
+    )
+    ident = [
+        rid for rid, t in split["tokens"].items()
+        if np.array_equal(fused["tokens"][rid], t)
+    ]
+    bit_identical = len(ident) == n_req
+    # BIT-parity is asserted on the f32 parity tier only (the tiny-model
+    # gates: tools/serve_smoke.py --fused pass,
+    # tests/test_ragged_attention.py). The flagship serving model is
+    # bf16, where XLA fuses elementwise chains differently across
+    # PROGRAM SHAPES — the fused W-wide block and the split n=1 step
+    # round some bf16 intermediates one ulp apart (measured: identical
+    # eager, 2^-6 max logit delta jitted, page-size dependent), so a
+    # near-tie token can legitimately flip and bf16 cross-program
+    # bitwise identity is not a stable property to assert. Reported
+    # instead; on TPU the split engine's lane-packed decode adds the
+    # same class of drift (ops/attention.py:lane_pack_enabled).
+    if jnp.dtype(dalle.dtype) == jnp.float32:
+        assert bit_identical, "fused tokens diverged from the split engine"
+
+    return {
+        "metric": f"serve_fused_dispatches_per_iteration_batch{max_batch}"
+                  + ("_int8" if int8 and model is None else ""),
+        "int8": bool(int8),
+        "value": round(fused["per_iter"], 4),
+        "unit": "dispatches/iteration",
+        "vs_baseline": None,
+        "split_dispatches_per_iteration": round(split["per_iter"], 4),
+        "dispatch_overhead_removed_per_iteration": round(
+            split["per_iter"] - fused["per_iter"], 4
+        ),
+        "fused_dispatches": fused["dispatches"],
+        "fused_iterations": fused["iterations"],
+        "split_dispatches": split["dispatches"],
+        "split_iterations": split["iterations"],
+        "mixed_iterations_fused": fused["mixed_iterations"],
+        # asserted for f32 models (the parity tier); for the bf16
+        # flagship it is reported — see the fusion-rounding note above
+        "fused_tokens_bit_identical_to_split": bool(bit_identical),
+        "requests_bit_identical": len(ident),
+        "parity_note": "bitwise parity is the f32 tier's contract "
+                       "(serve_smoke fused pass, test_ragged_attention); "
+                       "bf16 programs round ~1 ulp apart across program "
+                       "shapes under XLA fusion, so flagship parity is "
+                       "reported, not asserted",
+        "compiles_in_trace_fused": fused["compiles_trace"],
+        "jit_recompiles_in_trace_fused": fused["jit_recompiles_trace"],
+        "wall_split_s": round(split["wall"], 3),
+        "wall_fused_s": round(fused["wall"], 3),
+        "tokens_per_sec_split": round(split["tps"], 1),
+        "tokens_per_sec_fused": round(fused["tps"], 1),
+        "wall_note": "CPU wall times include the fused path's padded-row "
+                     "compute; the structural dispatch counts are the "
+                     "headline, TPU wall numbers pend a device session",
+        "prefill_chunk": chunk,
+        "n_requests": n_req,
+        "max_new_tokens": max_new,
         "arrival_seed": seed,
         "max_batch": max_batch,
         "device": jax.devices()[0].device_kind,
@@ -1482,6 +1699,7 @@ def main():
             print(json.dumps(_retry(lambda: bench_continuous_batching(on_cpu))))
         if "--serve" in only:
             print(json.dumps(_retry(lambda: bench_serve(on_cpu))))
+            print(json.dumps(_retry(lambda: bench_serve_fused(on_cpu))))
             print(json.dumps(_retry(lambda: bench_serve_interference(on_cpu))))
             if "--replicas" in sys.argv:
                 n = int(sys.argv[sys.argv.index("--replicas") + 1])

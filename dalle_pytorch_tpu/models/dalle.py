@@ -411,8 +411,10 @@ class DALLE(nn.Module):
         in-chunk causal block — so a sequence of ``prefill_chunk`` calls
         covering [0, T) produces a cache BIT-identical to one
         ``prefill_step`` over the same tokens, provided no chunk is a
-        single token (XLA's n == 1 matvec accumulates ~1 ulp differently;
-        see ``cache_block_attend``). Pinned by tests/test_chunked_prefill.
+        batch-1 single token (its PROJECTION matmuls would run as M=1
+        matvecs accumulating ~1 ulp differently; the attention core
+        itself pads width-1 blocks — ``cache_block_attend``). Pinned by
+        tests/test_chunked_prefill.
 
         Returns (b, total_tokens) logits predicting position start + c
         when ``return_logits`` (the final chunk of a prompt samples the
@@ -447,6 +449,96 @@ class DALLE(nn.Module):
         lm = jnp.asarray(self.logits_mask_np())
         mask_row = jax.lax.dynamic_slice_in_dim(lm, start + c - 1, 1, axis=0)
         return jnp.where(mask_row, NEG_INF, logits)
+
+    def fused_step(
+        self,
+        tokens: jnp.ndarray,
+        start: jnp.ndarray,
+        length: jnp.ndarray,
+        final: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        rowwise_head: bool = True,
+    ) -> jnp.ndarray:
+        """One RAGGED block step: a whole mixed prefill+decode serving
+        iteration through the transformer in ONE program ("Ragged Paged
+        Attention", PAPERS.md; ops/ragged_attention.py).
+
+        tokens: (b, W) per-row token blocks padded to the fixed iteration
+        width W — row b's valid tokens are columns [0, length[b]) at
+        internal positions start[b] + j. A decode row carries 1 token (an
+        image token at its decode position), a prefill-chunk row up to W
+        REMAPPED text ids, an idle row nothing (length 0). Raggedness is
+        DATA: every (start, length, final) mix shares this one trace, so
+        a serving iteration is a single device dispatch with a single
+        steady-state compile signature (serving/engine.py:_iteration_jit).
+
+        ``final``: (b,) bool, True for rows whose sampled token the
+        caller will CONSUME as a prefill's first image token (the
+        final-chunk rows). It selects the head's accumulation shape, not
+        its math: the split engine computes decode logits at batch width
+        (an M=b gemm) but a prefill's first-token logits in a batch-1
+        program whose M=1 head matvec accumulates ~1 ulp differently —
+        so this step computes BOTH (the gemm head plus b per-row M=1
+        heads) and selects per row, keeping fused output BITWISE equal
+        to the split engine for every row kind (pinned by
+        tests/test_ragged_attention). ``rowwise_head`` (STATIC) skips
+        the per-row heads when the caller knows no row is final — the
+        steady-state decode mix, where paying b extra head-weight matvec
+        streams every iteration would erode the fusion's dispatch win;
+        the engine passes ``bool(final.any())`` computed host-side, so
+        this is one extra (warm, never in-trace) compile signature, not
+        a per-mix recompile.
+
+        Returns (b, num_image_tokens) image-only logits at each row's
+        last valid position (garbage for idle/non-final intermediate
+        rows — the engine discards them by kind). Requires the paged
+        cache format and no gMLP layers, like every ragged-offset path.
+        """
+        b, n = tokens.shape
+        assert "mlp" not in tuple(self.attn_types or ("full",)), (
+            "fused_step cannot run gMLP layers (scalar-position gate history)"
+        )
+        pos = start[:, None] + jnp.arange(n, dtype=jnp.int32)[None]  # (b, n)
+        is_text = pos < self.text_len_internal
+
+        text_tok = jnp.clip(tokens, 0, self.num_text_tokens_ext - 1)
+        img_tok = jnp.clip(tokens, 0, self.num_image_tokens - 1)
+        emb = jnp.where(
+            is_text[..., None], self.text_emb(text_tok), self.image_emb(img_tok)
+        )
+        if not self.rotary_emb:
+            tpos = jnp.clip(pos, 0, self.text_len_internal - 1)
+            ipos = jnp.clip(
+                pos - self.text_len_internal, 0, self.image_seq_len - 1
+            )
+            img_grid = self.image_pos_emb(self.image_seq_len)
+            pe = jnp.where(
+                is_text[..., None],
+                self.text_pos_emb(tpos),
+                jnp.take(img_grid[0], ipos, axis=0),
+            )
+            emb = emb + pe.astype(emb.dtype)
+
+        out = self.transformer(
+            emb.astype(self.dtype),
+            mask=self._full_key_mask(
+                mask, self.text_len_internal + self.image_seq_len
+            ),
+            deterministic=True,
+            decode=True,
+            block_len=length,
+        )
+        last = jnp.clip(length - 1, 0, n - 1)
+        h_last = jnp.take_along_axis(
+            out, last[:, None, None], axis=1
+        )  # (b, 1, dim)
+        batched = self._head_image(h_last)[:, 0]  # (b, V_img), M=b gemm
+        if b == 1 or not rowwise_head:
+            return batched
+        rowwise = jnp.concatenate(
+            [self._head_image(h_last[i:i + 1]) for i in range(b)], axis=0
+        )[:, 0]  # per-row M=1 — the split prefill head's accumulation
+        return jnp.where(final[:, None], rowwise, batched)
 
     def decode_step(
         self,

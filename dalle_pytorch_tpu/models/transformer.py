@@ -227,15 +227,22 @@ class Transformer(nn.Module):
 
     # ------------------------------------------------------------------ call
 
-    def _block_kwargs(self, ind: int, mask, rot, deterministic, decode):
+    def _block_kwargs(self, ind: int, mask, rot, deterministic, decode,
+                      block_len=None):
         """(attn kwargs, ff kwargs) for layer ``ind`` in module-call form."""
         kind = self.layer_kinds[ind]
         akw: dict = dict(deterministic=deterministic, decode=decode)
         if kind != "mlp":
             akw.update(mask=mask, rotary_pos_emb=rot)
+            if block_len is not None:
+                akw["block_len"] = block_len
         fkw: dict = dict(deterministic=deterministic)
         if self.shift_tokens:
             fkw.update(decode=decode)
+            if block_len is not None:
+                # the FF-side PreShiftToken consumes block_len for its own
+                # ragged ring advance (it never forwards it to the FF)
+                fkw["block_len"] = block_len
         return akw, fkw
 
     def __call__(
@@ -244,6 +251,7 @@ class Transformer(nn.Module):
         mask: Optional[jnp.ndarray] = None,
         deterministic: bool = True,
         decode: bool = False,
+        block_len: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
         rot_np = self.rotary_table()
         # a content-interned StaticTable, not a traced array: the attention
@@ -272,7 +280,9 @@ class Transformer(nn.Module):
 
         if sequential and not self.reversible:
             for ind in range(self.depth):
-                akw, fkw = self._block_kwargs(ind, mask, rot, deterministic, decode)
+                akw, fkw = self._block_kwargs(
+                    ind, mask, rot, deterministic, decode, block_len
+                )
                 x = x + self.attn_blocks[ind](x, **akw)
                 x = x + self.ff_blocks[ind](x, **fkw)
             return x
@@ -281,7 +291,9 @@ class Transformer(nn.Module):
             # reversible wiring, run directly (no custom VJP needed)
             x1, x2 = x, x
             for ind in range(self.depth):
-                akw, fkw = self._block_kwargs(ind, mask, rot, deterministic, decode)
+                akw, fkw = self._block_kwargs(
+                    ind, mask, rot, deterministic, decode, block_len
+                )
                 x1 = x1 + self.attn_blocks[ind](x2, **akw)
                 x2 = x2 + self.ff_blocks[ind](x1, **fkw)
             return (x1 + x2) / 2

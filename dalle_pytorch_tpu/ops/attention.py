@@ -89,6 +89,22 @@ Dtype = Any
 NEG_INF = -0.7 * float(np.finfo(np.float32).max)
 
 
+def lane_pack_enabled() -> bool:
+    """Whether single-token decode sweeps may use the lane-packed
+    formulation (``PatternAttention._cache_attend``). "auto" (default):
+    TPU only — it was measured there (0.823 -> 0.813 ms/token, v5e int8)
+    and its regrouped contraction is NOT bitwise equal to the plain gemm
+    at every head count (h=16, d=64 measured ~5e-7 apart on CPU), while
+    the CPU tier is where the fused-vs-split serving BIT-parity gates
+    run (tests/test_ragged_attention.py, tools/serve_smoke.py): gating
+    the pack off-TPU keeps every CPU decode path on the one shared gemm.
+    ``DALLE_TPU_LANE_PACK=0|1`` forces either way (tests use 1 to
+    exercise the packed math on CPU)."""
+    from .kv_policy import tpu_auto_env
+
+    return tpu_auto_env("DALLE_TPU_LANE_PACK")
+
+
 def _softmax(scores: jnp.ndarray, stable: bool, axis: int = -1) -> jnp.ndarray:
     scores = scores.astype(jnp.float32)
     return (
@@ -130,17 +146,37 @@ def cache_block_attend(
     prefill (``DALLE.prefill_step``), CHUNKED prefill
     (``DALLE.prefill_chunk`` — each chunk attends the already-written
     paged-KV prefix, assembled by ``paged_kv.gather`` through the page
-    table, plus its own in-chunk causal rows of the pattern mask), and
-    the n > 1 branch of every cache format all route here through
-    ``PatternAttention._cache_attend``. One implementation means chunked
-    and monolithic prefill share every einsum, which is what makes
-    chunk-size-invariant BIT-parity achievable at all — with one measured
-    caveat: XLA lowers n == 1 blocks to a matvec whose accumulation
-    differs from the n >= 2 gemm by ~1 ulp (CPU, 2026-08), so callers
-    that pin bitwise parity must never emit 1-token blocks (the serving
-    engine merges a would-be 1-token final chunk into its predecessor)."""
+    table, plus its own in-chunk causal rows of the pattern mask), the
+    fused ragged iteration (``ops/ragged_attention.py``'s reference
+    path), and the n > 1 branch of every cache format all route here
+    through ``PatternAttention._cache_attend``. One implementation means
+    chunked and monolithic prefill share every einsum, which is what
+    makes chunk-size-invariant BIT-parity achievable at all.
+
+    Width-1 blocks are deliberately computed as width-2 gemms (q row
+    duplicated, result sliced back): XLA lowers a genuine n == 1 block to
+    a matvec whose accumulation differs from the n >= 2 gemm by ~1 ulp
+    (CPU, measured 2026-08 and re-confirmed for this fix). The pad
+    resolves that caveat IN THE ATTENTION CORE: per-row results here are
+    bitwise invariant across every block width n >= 1 AND across batch
+    widths (both verified on CPU, pinned by
+    tests/test_ragged_attention.py), so the fused ragged path needs no
+    1-token-tail special case — its rows are padded to the iteration
+    width anyway. NOTE the split engine still merges 1-token final
+    chunks (engine._next_chunk): a batch-1 width-1 block's
+    PROJECTION/FFN matmuls run as M=1 matvecs with the same ~1-ulp
+    accumulation drift, which this pad cannot reach — the residual
+    caveat is pinned precisely in tests/test_ragged_attention.py. Cost
+    of the pad: one duplicated query row on a path whose work is
+    dominated by the W-row cache sweep."""
     b, n, h, d = q.shape
     W = k_cache.shape[1]
+    if n == 1:
+        out = cache_block_attend(
+            jnp.concatenate((q, q), axis=1), k_cache, v_cache, allowed,
+            stable,
+        )
+        return out[:, :1]
     scores = jnp.einsum(
         "bnhd,blhd->bhnl", q, k_cache.reshape(b, W, h, d),
         preferred_element_type=jnp.float32,
@@ -223,6 +259,7 @@ class PatternAttention(nn.Module):
         deterministic: bool = True,
         decode: bool = False,
         force_dense: bool = False,
+        block_len: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
         b, n, _ = x.shape
         h, d = self.heads, self.dim_head
@@ -266,11 +303,17 @@ class PatternAttention(nn.Module):
             else:
                 # multi-token prefill blocks and non-"full" patterns: the
                 # unfused path, (b, n, h, d) end to end against the same
-                # n-major caches the kernel aliases
+                # n-major caches the kernel aliases. ``block_len`` (b,)
+                # marks a RAGGED block (the fused serving iteration): row
+                # b's valid tokens are columns [0, block_len[b]) — K/V
+                # writes are masked to them and the cache index advances
+                # per row (ops/ragged_attention.py).
                 q, k, v = (
                     t.reshape(b, n, h, d) for t in jnp.split(qkv, 3, axis=-1)
                 )
-                out = self._decode_attend(q, k, v, mask, rotary_pos_emb)
+                out = self._decode_attend(
+                    q, k, v, mask, rotary_pos_emb, block_len=block_len
+                )
                 out = out.reshape(b, n, inner)
         else:
             from ..parallel.context import sp_extent
@@ -744,7 +787,7 @@ class PatternAttention(nn.Module):
         ck = self.get_variable("cache", "cached_key")
         return ck.shape[1] != self.seq_len
 
-    def _decode_attend(self, q, k, v, mask, rotary_pos_emb):
+    def _decode_attend(self, q, k, v, mask, rotary_pos_emb, block_len=None):
         """Decode against an n-major (b, W, h, d) K/V cache: single-token
         steps or multi-token prefill blocks (n > 1, e.g. the text prompt in
         one parallel pass). Each new token's row of the pattern mask selects
@@ -768,7 +811,15 @@ class PatternAttention(nn.Module):
         differently)."""
         b, n, h, d = q.shape
         if self._cache_format(b) == "paged":
-            return self._decode_attend_paged(q, k, v, mask, rotary_pos_emb)
+            return self._decode_attend_paged(
+                q, k, v, mask, rotary_pos_emb, block_len=block_len
+            )
+        if block_len is not None:
+            raise ValueError(
+                "ragged blocks (block_len) need the paged cache format: "
+                "the flat/4d formats' scalar cache index cannot advance "
+                "per row"
+            )
 
         cached_key, cached_value, cache_index, is_init = self._decode_caches(
             b, k.dtype
@@ -829,7 +880,8 @@ class PatternAttention(nn.Module):
         )
         return k_pool, v_pool, table, cache_index, is_init
 
-    def _decode_attend_paged(self, q, k, v, mask, rotary_pos_emb):
+    def _decode_attend_paged(self, q, k, v, mask, rotary_pos_emb,
+                             block_len=None):
         """Decode against the block-paged cache: rotary rows, pattern-mask
         rows, and the write position are all indexed PER SEQUENCE from the
         (b,) cache index, so a batch whose sequences sit at different
@@ -841,8 +893,22 @@ class PatternAttention(nn.Module):
         are zeros under a False pattern-mask column, the same masked-zeros
         argument as the flat path). Attention arithmetic is the shared
         ``_cache_attend``, so paged/flat/4-D parity is exact by
-        construction."""
-        from . import paged_kv
+        construction.
+
+        ``block_len`` (b,) marks a RAGGED block — the fused serving
+        iteration's descriptor (ops/ragged_attention.py): row b's valid
+        tokens are columns [0, block_len[b]) of the padded width-n block.
+        K/V writes are masked to the valid columns (``paged_kv.append``
+        ``limit``), the cache index advances by block_len per row, and on
+        TPU the attention core dispatches to the Pallas ragged
+        paged-attention kernel for causal-"full" layers; everywhere else
+        it stays the gathered-view ``_cache_attend`` — the SAME einsums
+        as the split prefill-chunk/decode paths, which is what makes
+        fused-vs-split engine parity bitwise on the f32 CPU tier. Invalid
+        columns
+        compute garbage that is finite (clipped mask rows keep at least
+        one key visible) and discarded by the caller."""
+        from . import paged_kv, ragged_attention
 
         b, n, h, d = q.shape
         k_pool, v_pool, table, cache_index, is_init = self._paged_caches(
@@ -863,12 +929,25 @@ class PatternAttention(nn.Module):
 
         hd = h * d
         k_pool.value = paged_kv.append(
-            k_pool.value, table.value, idx, k.reshape(b, n, hd)
+            k_pool.value, table.value, idx, k.reshape(b, n, hd),
+            limit=block_len,
         )
         v_pool.value = paged_kv.append(
-            v_pool.value, table.value, idx, v.reshape(b, n, hd)
+            v_pool.value, table.value, idx, v.reshape(b, n, hd),
+            limit=block_len,
         )
-        cache_index.value = idx + n
+        cache_index.value = idx + (n if block_len is None else block_len)
+
+        causal_full = self.attn_type == "full" and self.causal
+        if (
+            block_len is not None
+            and ragged_attention.use_kernel(causal_full, mask is not None)
+        ):
+            return ragged_attention.kernel_attend(
+                q, k_pool.value, v_pool.value, table.value, idx, block_len,
+                interpret=jax.devices()[0].platform != "tpu",
+            )
+
         k_cache = paged_kv.gather(k_pool.value, table.value)  # (b, W, h*d)
         v_cache = paged_kv.gather(v_pool.value, table.value)
         W = k_cache.shape[1]
@@ -897,13 +976,20 @@ class PatternAttention(nn.Module):
         b, n, h, d = q.shape
         W = k_cache.shape[1]
 
-        if n == 1 and d < 128 and 128 % d == 0 and h % (128 // d) == 0:
+        if (
+            n == 1 and d < 128 and 128 % d == 0 and h % (128 // d) == 0
+            and lane_pack_enabled()
+        ):
             # lane-packed single-token sweeps: dim_head < 128 half-fills
             # the vector lanes of the (L, h, d) cache tiles, capping the
             # QK/AV sweeps at ~250 GB/s (trace-measured). Packing P=128/d
             # heads per 128-lane tile with a block-diagonal q restores
-            # full-lane contractions — exact same arithmetic, better
-            # effective bandwidth on the serving hot loop.
+            # full-lane contractions — same math, better effective
+            # bandwidth on the serving hot loop. TPU-gated
+            # (lane_pack_enabled): the regrouped contraction is ~1-ulp
+            # off the plain gemm at some head counts, and off-TPU the
+            # fused-vs-split bit-parity gates need every decode on the
+            # one shared gemm below.
             P_ = 128 // d
             G = h // P_
             eye = jnp.eye(P_, dtype=q.dtype)
@@ -934,8 +1020,10 @@ class PatternAttention(nn.Module):
     # rest. The sweeps ran at only ~250 GB/s because dim_head=64 half-fills
     # the 128-lane tiles of the (b, L, h, d) caches. The lane-packed XLA
     # reformulation in _cache_attend above (P heads per 128-lane tile,
-    # block-diagonal q — exact arithmetic) recovers part of that: measured
-    # int8 0.823 -> 0.813 ms/token, bf16 1.044 -> 1.029 (reproduced twice).
+    # block-diagonal q — same math, ~1 ulp off the plain gemm at some head
+    # counts, hence TPU-gated via lane_pack_enabled) recovers part of that:
+    # measured int8 0.823 -> 0.813 ms/token, bf16 1.044 -> 1.029
+    # (reproduced twice).
     # The same packing done as a Pallas kernel (ops/decode_attention.py)
     # measured SLOWER than XLA's chain (skinny-MXU latency) and stays
     # opt-in; the residual sweep inefficiency is the remaining frontier.

@@ -87,6 +87,25 @@ _OVERRIDE: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
 )
 
 
+def tpu_auto_env(name: str) -> bool:
+    """Tri-state env gate for TPU-only optimizations: "auto" (the
+    default when the variable is unset) resolves to backend == "tpu";
+    "1"/"0" force either way. ONE parser for every such knob —
+    ``DALLE_TPU_LANE_PACK`` (ops/attention.py:lane_pack_enabled) and
+    ``DALLE_TPU_RAGGED_KERNEL`` (ops/ragged_attention.py:use_kernel) —
+    so platform resolution and error wording cannot drift between them.
+    jax is imported lazily: only the "auto" branch needs a backend, and
+    this module stays import-light for pure policy callers."""
+    v = os.environ.get(name, "auto")
+    if v not in ("auto", "0", "1"):
+        raise ValueError(f"{name} must be 'auto', '0' or '1', got {v!r}")
+    if v == "auto":
+        import jax
+
+        return jax.devices()[0].platform == "tpu"
+    return v == "1"
+
+
 def page_size() -> int:
     """Page row count; ``DALLE_TPU_KV_PAGE_SIZE`` overrides (tests use tiny
     pages to exercise page-boundary arithmetic on small models)."""

@@ -293,12 +293,14 @@ class PreShiftToken(nn.Module):
     pass_decode: bool = False
 
     @nn.compact
-    def __call__(self, x, decode: bool = False, **kwargs):
+    def __call__(self, x, decode: bool = False, block_len=None, **kwargs):
         img_seq_len = self.image_size**2
         text_len = self.seq_len - img_seq_len + 1
         inner_kwargs = dict(kwargs)
         if self.pass_decode:
             inner_kwargs["decode"] = decode
+            if block_len is not None:
+                inner_kwargs["block_len"] = block_len
 
         if not decode:
             x = shift_tokens(x, text_len, self.image_size)
@@ -325,7 +327,37 @@ class PreShiftToken(nn.Module):
             return self.fn(x, **inner_kwargs)
 
         pos = pos_var.value
-        if n > 1:
+        if block_len is not None:
+            # RAGGED block (the fused serving iteration): row b's valid
+            # tokens are columns [0, block_len[b]) at positions
+            # pos[b] + j, mixing text (prefill rows) and image (decode
+            # rows) — the per-position decode rules apply elementwise.
+            # ``cat`` maps any position pos[b] + t (t in [-R, n)) to
+            # column R + t: prev is position p-1 (column R+j-1), the
+            # row-above token p - image_size (column R+j-image_size;
+            # R = image_size + 1 keeps both indices >= 0). The ring then
+            # advances PER ROW by block_len — a pure gather, bitwise
+            # equal to the split paths' concatenate update at the same
+            # advance (idle rows advance 0 and keep their ring intact).
+            assert jnp.ndim(pos) == 1, (
+                "ragged blocks need a vectorized (b,) shift index "
+                "(models/sampling.py:set_decode_offsets)"
+            )
+            jidx = jnp.arange(n, dtype=jnp.int32)
+            cat = jnp.concatenate((hist.value, x), axis=1)  # (b, R+n, d)
+            prev = cat[:, R - 1 + jidx]                     # (b, n, d)
+            row_above = cat[:, R - self.image_size + jidx]
+            pos_bj = pos[:, None] + jidx[None]              # (b, n)
+            take = jnp.minimum(
+                jnp.arange(R, dtype=jnp.int32)[None] + block_len[:, None],
+                R + n - 1,
+            )
+            hist.value = jnp.take_along_axis(cat, take[..., None], axis=1)
+            pos_var.value = pos + block_len
+            x = shift_tokens_decode(
+                x, pos_bj, prev, row_above, text_len, self.image_size
+            )
+        elif n > 1:
             # prefill: a block of n text positions (n <= text_len and the
             # whole block must lie inside the text part — callers prefill the
             # prompt; pos is traced so this cannot be asserted). Only the
@@ -484,15 +516,18 @@ def shift_tokens_decode(
 ) -> jnp.ndarray:
     """Single-position token shift for the KV-cached decode loop.
 
-    x: (b, 1, d) current token features; pos: scalar int32 global position,
-    or (b,) per-sequence positions (ragged decode offsets / continuous
-    batching — every position test below is elementwise, so the vector form
-    broadcasts over the batch); prev_token / row_above_token: (b, 1, d)
-    features of positions pos-1 and pos-image_size (zeros when out of
-    range / across a boundary).
+    x: (b, n, d) current token features (n == 1 for the classic decode
+    step); pos: scalar int32 global position, (b,) per-sequence positions
+    (ragged decode offsets / continuous batching), or (b, n) per-token
+    positions of a ragged BLOCK (the fused serving iteration) — every
+    position test below is elementwise, so all forms broadcast;
+    prev_token / row_above_token: (b, n, d) features of positions pos-1
+    and pos-image_size (zeros when out of range / across a boundary).
     """
     if jnp.ndim(pos) == 1:
         pos = pos[:, None, None]  # broadcast per-sequence over (b, 1, d)
+    elif jnp.ndim(pos) == 2:
+        pos = pos[..., None]      # (b, n) per-token over (b, n, d)
     d = x.shape[-1]
     is_text = pos < text_len
     p_img = pos - text_len

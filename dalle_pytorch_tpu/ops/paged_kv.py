@@ -103,6 +103,7 @@ def append(
     table: jnp.ndarray,
     index: jnp.ndarray,
     rows: jnp.ndarray,
+    limit: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Write ``rows`` (b, n, feat) at per-sequence positions
     ``index`` (b,) .. index+n into the paged ``pool`` (b, n_pages, page, feat)
@@ -113,6 +114,12 @@ def append(
     ``pos % page``. Out-of-capacity positions are dropped, matching the
     flat path's dynamic_update_slice clamp semantics at the buffer edge
     only in never-read positions (callers guarantee index + n <= capacity).
+
+    ``limit`` (b,) int32, optional: per-sequence VALID row count — rows
+    j >= limit[b] are dropped, never written. This is the ragged fused
+    iteration's write mask (ops/ragged_attention.py): every cache row
+    receives the same padded (b, n, feat) block, but a decode row commits
+    one position, a prefill chunk its own width, an idle row nothing.
     """
     b, n_p, page, feat = pool.shape
     n = rows.shape[1]
@@ -121,7 +128,12 @@ def append(
     off = pos % page
     phys = jnp.take_along_axis(table, jnp.minimum(logical, n_p - 1), axis=1)
     # drop (not clamp) genuinely out-of-capacity rows
-    phys = jnp.where(logical < n_p, phys, n_p)
+    valid = logical < n_p
+    if limit is not None:
+        valid = valid & (
+            jnp.arange(n, dtype=jnp.int32)[None, :] < limit[:, None]
+        )
+    phys = jnp.where(valid, phys, n_p)
     bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, n))
     return pool.at[bidx, phys, off].set(rows, mode="drop")
 

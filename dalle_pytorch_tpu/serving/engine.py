@@ -30,9 +30,11 @@ pages are freed the iteration the termination sweeps, not at the end of an
 uninterruptible prefill — and the ``prefill_fail`` fault fires at chunk
 granularity with retry resuming from the last completed chunk. The final
 chunk samples the first image token exactly like the monolithic path, so
-chunked and monolithic prefill are BIT-identical (the chunker never emits
-a 1-token chunk — ``ops/attention.py:cache_block_attend``'s measured
-caveat — merging such a tail into its predecessor).
+chunked and monolithic prefill are BIT-identical (the split chunker never
+emits a batch-1 width-1 block — its projection matmuls would run as M=1
+matvecs with ~1-ulp-different accumulation — merging such a tail into its
+predecessor; the fused path pads rows to the iteration width instead and
+needs no merge).
 
 One-step-lookahead decode (``EngineConfig.decode_lookahead``, default on):
 iteration N+1's decode step is dispatched BEFORE iteration N's sampled
@@ -45,6 +47,28 @@ values), and deadline/cancel semantics are defined AT READBACK TIME: a
 sample still in flight when its request terminates is simply dropped, and
 replay-after-eviction stays bit-identical because tokens depend only on
 the (seed, position) fold-in keys, never on when they were read.
+
+Fused ragged iteration (``EngineConfig.fused_iteration``; ROADMAP 1,
+"Ragged Paged Attention"): the split scheduler above still costs one jit
+DISPATCH per prefill chunk plus one per decode step — per-iteration host
+overhead that scales with the prefill mix, with a compile signature per
+chunk class. Fused mode collapses a whole TokenBudget iteration into ONE
+``_iteration_jit`` dispatch over ``DALLE.fused_step``: every cache row
+gets a (start, length, final) descriptor padded to the fixed iteration
+width (the chunk size), prefilling rows write their chunks DIRECTLY into
+their row of the batched cache (no private batch-1 cache, no insert —
+chunks are gathered in-trace from an on-device prompts buffer), and the
+decode rows ride the same block. Raggedness is data, not shape: a
+steady-state iteration has exactly one compile signature (DTL11x) and
+one dispatch regardless of the mix, and grants up to ``max_batch``
+prefill chunks IN PARALLEL where the split path ran them sequentially.
+Scheduling semantics are preserved — decode-first budget with the
+head-of-line floor (``TokenBudget.plan_iteration``), chunk-granular
+``prefill_fail`` with resume-from-last-chunk, terminations between
+iterations with same-iteration page release — and fused output is
+BIT-identical to the split engine for f32 models on CPU — the parity
+tier every smoke/test gate runs on (every row kind shares the
+split paths' exact einsums; ops/ragged_attention.py).
 
 Determinism contract (pinned by tests/test_serving.py +
 tests/test_chunked_prefill.py): a request's token at internal position p
@@ -126,9 +150,10 @@ class EngineConfig:
     preempt_priority_boost: int = 1
     prefill_attempts: int = 2
     stall_penalty_s: float = 1.0
-    # chunked prefill: prompt tokens per chunk (>= 2 — a 1-token chunk is
-    # the one block width XLA accumulates differently, breaking bit-parity
-    # with monolithic prefill; cache_block_attend). None = monolithic.
+    # chunked prefill: prompt tokens per chunk (>= 2 — a batch-1 width-1
+    # chunk's projection matmuls are M=1 matvecs that accumulate ~1 ulp
+    # differently from gemms; the split path merges 1-token tails, the
+    # fused path pads rows instead). None = monolithic.
     prefill_chunk: Optional[int] = None
     # per-iteration token budget shared between decode tokens and prefill
     # chunk tokens (chunked mode only). None = max_batch + prefill_chunk,
@@ -137,6 +162,16 @@ class EngineConfig:
     token_budget: Optional[int] = None
     # dispatch decode step N+1 before reading back step N's samples
     decode_lookahead: bool = True
+    # execute each engine iteration — every prefill chunk plus the vector
+    # decode step — as ONE fused ragged dispatch (_iteration_jit over
+    # DALLE.fused_step; requires prefill_chunk). Raggedness is data, so a
+    # steady-state iteration has exactly one compile signature and one
+    # device dispatch regardless of the prefill/decode mix (ROADMAP 1,
+    # "Ragged Paged Attention"). Off by default pending TPU measurement;
+    # fused output is pinned bit-identical to the split path on the f32
+    # CPU parity tier
+    # (tests/test_ragged_attention.py, tools/serve_smoke.py --fused).
+    fused_iteration: bool = False
 
 
 _PREFILL = "prefill"
@@ -260,6 +295,52 @@ def _decode_jit(dalle: DALLE, params, cache, tok, pos, keys, k: int,
     return mutated["cache"], samples.astype(jnp.int32)
 
 
+@partial(jax.jit, static_argnums=(0, 9, 10, 12), donate_argnums=(2,))
+def _iteration_jit(dalle: DALLE, params, cache, prompts, tok, start, length,
+                   final, keys, width: int, k: int, temperature,
+                   any_final: bool = False):
+    """One ENTIRE TokenBudget iteration as a single device dispatch: every
+    granted prefill chunk plus the vector-position decode step run as one
+    ragged (B, width) block through ``DALLE.fused_step`` (descriptors —
+    start/length/final — are DATA, so every prefill/decode mix shares
+    this one steady-state compile signature; DTL11x pins it to exactly
+    one). Per-row token sources are resolved IN-TRACE: a decode row
+    (start >= T, i.e. at an image position) consumes ``tok`` — the
+    previous iteration's still-on-device samples where lookahead applies
+    — while a prefill row gathers its chunk from its row of the
+    ``prompts`` buffer, so the host never touches token values on the
+    steady path. ``any_final`` (static, host-known scheduling fact) is
+    the ONE extra signature class: iterations containing a FINAL chunk
+    additionally run the per-row split-parity heads
+    (``DALLE.fused_step`` ``rowwise_head``) — both classes compile at
+    warmup, in-trace recompiles stay zero, and the steady mixed
+    prefill+decode iteration remains exactly one signature. Sampling is
+    the split paths' exact op sequence
+    (image-only top-k + per-row fold-in keys, vmapped categorical); rows
+    whose sample the host will not consume (idle, intermediate chunks)
+    burn a filler key and are discarded by kind at readback. The batched
+    cache is DONATED like every serving jit (PR 8 discipline): the
+    iteration's output cache aliases its input's buffers, audited by
+    DTL12x on the lowered computation."""
+    B, T = prompts.shape
+    j = jnp.arange(width, dtype=jnp.int32)[None]
+    chunk = jnp.take_along_axis(
+        prompts, jnp.minimum(start[:, None] + j, T - 1), axis=1
+    )
+    dec_tok = jnp.pad(tok[:, None], ((0, 0), (0, width - 1)))
+    tokens = jnp.where((start >= T)[:, None], dec_tok, chunk)
+    logits, mutated = dalle.apply(
+        {"params": params, "cache": cache},
+        tokens, start, length, final,
+        rowwise_head=any_final,
+        method=DALLE.fused_step,
+        mutable=["cache"],
+    )
+    filtered = top_k_filter(logits, k=k) / temperature
+    samples = jax.vmap(jax.random.categorical)(keys, filtered)
+    return mutated["cache"], samples.astype(jnp.int32)
+
+
 class Engine:
     """See module docstring. Host-side state machine + one device cache."""
 
@@ -276,10 +357,11 @@ class Engine:
             )
         if config.prefill_chunk is not None and config.prefill_chunk < 2:
             raise ValueError(
-                f"prefill_chunk must be >= 2 (a 1-token chunk is the one "
-                f"block width XLA accumulates ~1 ulp differently, breaking "
-                f"bit-parity with monolithic prefill; "
-                f"ops/attention.py:cache_block_attend), got "
+                f"prefill_chunk must be >= 2 (a batch-1 width-1 chunk runs "
+                f"its projection matmuls as M=1 matvecs that accumulate "
+                f"~1 ulp differently from gemms, breaking split-path "
+                f"bit-parity with monolithic prefill; the fused path pads "
+                f"rows to the iteration width instead), got "
                 f"{config.prefill_chunk}"
             )
         self.dalle = dalle
@@ -371,6 +453,33 @@ class Engine:
         # top-k count derived from the FULL vocab (reference fractional-k
         # semantics over the pre-sliced image logits; models/sampling.py)
         self.k_img = max(int((1 - config.filter_thres) * dalle.total_tokens), 1)
+        # fused ragged iteration (ROADMAP 1): one _iteration_jit dispatch
+        # per engine iteration. Prefilling rows build their prompt
+        # DIRECTLY in their row of the batched cache (no private batch-1
+        # cache, no insert), reading their chunks from the on-device
+        # prompts buffer — the host only moves descriptors.
+        self.fused = config.fused_iteration
+        if self.fused:
+            if config.prefill_chunk is None:
+                raise ValueError(
+                    "fused_iteration requires chunked prefill "
+                    "(prefill_chunk): the fused block width is the chunk "
+                    "width"
+                )
+            self._W = config.prefill_chunk
+            self._prompts = jnp.zeros((B, self.T), jnp.int32)
+            # the fused jit donates the cache on its FIRST dispatch, when
+            # it is still the pristine init tree — whose index leaves
+            # alias one buffer (set_decode_offsets hands cache_index and
+            # shift_index the same offsets array). Donation forbids
+            # aliased inputs; one copy de-aliases the tree once
+            self.cache = jax.tree_util.tree_map(jnp.copy, self.cache)
+        # dispatch accounting (bench.py --serve): model-jit calls and
+        # engine iterations that did device work — steady-state fused mode
+        # is exactly 1 dispatch/iteration, the split path one per prefill
+        # chunk plus one decode step
+        self.dispatches = 0
+        self.iterations = 0
 
     # ------------------------------------------------------------ public
 
@@ -410,13 +519,20 @@ class Engine:
         self._cancel_requested.add(request_id)
 
     def step(self) -> bool:
-        """One scheduling iteration: terminations -> admission -> one
-        decode step -> budgeted prefill chunks. Returns False when the
-        engine is fully idle."""
+        """One scheduling iteration: terminations -> admission -> device
+        work. Split mode: one decode step then budgeted prefill chunks,
+        each its own jit dispatch. Fused mode: the whole iteration —
+        decode rows AND granted prefill chunks — as ONE ragged dispatch.
+        Returns False when the engine is fully idle."""
         self._sweep_terminations()
         self._admit()
-        worked = self._decode_once()
-        worked = self._advance_prefills() or worked
+        if self.fused:
+            worked = self._fused_iteration()
+        else:
+            worked = self._decode_once()
+            worked = self._advance_prefills() or worked
+        if worked:
+            self.iterations += 1
         self.clock.tick()
         self._publish_gauges()
         return worked or bool(self.sched) or any(self.slots)
@@ -608,9 +724,16 @@ class Engine:
             admit_seq=self._admit_seq, phase=_PREFILL,
         )
         self._admit_seq += 1
-        slot.cache1 = self._fresh_prefill_cache()
         text = jnp.asarray(entry.request.prompt, jnp.int32)[None, :]
-        slot.internal = self.dalle.remap_text(text)
+        internal = self.dalle.remap_text(text)
+        if self.fused:
+            # fused mode: the row prefills IN PLACE in the batched cache
+            # (reset to pristine at release), chunks gathered in-trace
+            # from the prompts buffer — one small row write per admission
+            self._prompts = self._prompts.at[idx].set(internal[0])
+        else:
+            slot.cache1 = self._fresh_prefill_cache()
+            slot.internal = internal
         slot.filled = 0
         slot.prefill_span = TELEMETRY.begin(
             "serve.prefill",
@@ -682,6 +805,8 @@ class Engine:
         key = jax.random.fold_in(
             jax.random.key(entry.request.seed), self.T
         )
+        self.dispatches += 1
+        self.counters.inc("serve.dispatches")
         cache1, tok = _prefill_jit(
             self.dalle, self.params, self._fresh_prefill_cache(), internal,
             key, self.k_img, self.config.temperature,
@@ -691,15 +816,28 @@ class Engine:
     # ----------------------------------------------------- chunked prefill
 
     def _next_chunk(self, filled: int) -> int:
-        """Width of the next prefill chunk: the configured size, except a
-        would-be 1-token TAIL is merged into this chunk (widths of 1 are
-        the one case XLA accumulates differently — cache_block_attend —
-        and bit-parity with monolithic prefill is a pinned contract)."""
+        """Width of the next SPLIT-path prefill chunk: the configured
+        size, except a would-be 1-token TAIL is merged into this chunk.
+        The attention core no longer cares (``cache_block_attend`` pads
+        width-1 blocks to width-2 gemms), but a batch-1 width-1 chunk
+        still runs its PROJECTION/FFN matmuls as M=1 matvecs whose
+        accumulation differs ~1 ulp from the M>=2 gemm (pinned by
+        tests/test_ragged_attention.py), so the split path keeps the
+        merge. The FUSED path needs no such special case: every row of
+        its fixed-width block is padded to the iteration width, so its
+        tails are gemm-shaped by construction (``_next_chunk_fused``)."""
         chunk = self.config.prefill_chunk
         c = min(chunk, self.T - filled)
         if self.T - filled - c == 1:
             c += 1
         return c
+
+    def _next_chunk_fused(self, filled: int) -> int:
+        """Width of the next FUSED-path prefill chunk: the configured
+        size or the plain ragged tail — no 1-token-tail merge, because
+        the fused block computes every row at the fixed iteration width
+        (a 1-token tail is just one valid column of a padded row)."""
+        return min(self.config.prefill_chunk, self.T - filled)
 
     def _advance_prefills(self) -> bool:
         """Run this iteration's budgeted prefill chunks: in-progress
@@ -755,6 +893,8 @@ class Engine:
                     request_id=entry.request_id, parent=slot.prefill_span,
                     start=slot.filled, tokens=c,
                 ):
+                    self.dispatches += 1
+                    self.counters.inc("serve.dispatches")
                     if final:
                         key = jax.random.fold_in(
                             jax.random.key(entry.request.seed), self.T
@@ -811,6 +951,231 @@ class Engine:
         slot.phase = _DECODE
         slot.tok_on_device = False
         self._record_first_token(entry, now)
+        if len(entry.generated) >= entry.effective_max_new:
+            self._complete(slot)
+
+    # ------------------------------------------------------ fused iteration
+
+    def _fused_iteration(self) -> bool:
+        """One TokenBudget iteration as ONE device dispatch
+        (``_iteration_jit``): the host assembles per-row DESCRIPTORS —
+        decode rows for every dispatchable decoding slot (page growth and
+        preemption exactly as ``_decode_once``), one prefill chunk for
+        each granted prefilling slot (``TokenBudget.plan_iteration``;
+        ``prefill_fail`` still fires at CHUNK granularity per row, retry
+        resuming from ``slot.filled``) — and scatters positions and
+        fold-in keys; token VALUES stay on device (decode inputs ride the
+        previous iteration's sample array, chunks are gathered in-trace
+        from the prompts buffer). Lookahead semantics are unchanged: with
+        it on, this iteration's samples are read back next iteration, so
+        a final chunk's first image token flows into its own decode phase
+        without ever visiting the host.
+
+        This deliberately PARALLELS ``_decode_once``/``_dispatch_decode``
+        rather than sharing helpers: the two modes differ in pending
+        structure (bare slots vs (slot, kind) tuples), chunk handling,
+        and transition timing, and the split scheduler is the path
+        slated for retirement once fused mode is TPU-measured — folding
+        them together would couple a frozen, pinned code path to one
+        still expected to evolve. A fix to genuinely shared logic (the
+        page-growth/preemption loop, the lookahead swap) currently needs
+        applying in both."""
+        cfg = self.config
+        if FAULTS.take("decode_stall"):
+            self.counters.inc("serve.fault_decode_stall")
+            TELEMETRY.event(
+                "serve.decode_stall", penalty_s=cfg.stall_penalty_s
+            )
+            self.clock.advance(cfg.stall_penalty_s)
+        pending = self._pending
+        # a pending FINAL-chunk sample counts like a decode sample: it
+        # becomes generated[0] at readback (completion is count-based)
+        in_flight = (
+            set() if pending is None else {id(s) for s, _ in pending[1]}
+        )
+        dispatchable = [
+            s for s in self.slots
+            if s and s.phase == _DECODE
+            and len(s.entry.generated) + (1 if id(s) in in_flight else 0)
+            < s.entry.effective_max_new
+        ]
+        for slot in sorted(
+            dispatchable,
+            key=lambda s: -self.sched.effective_priority(s.entry),
+        ):
+            if self.slots[slot.index] is not slot:
+                continue
+            needed = slot.pos // self.page + 1
+            deficit = needed - self.pool.held(slot.entry.request_id)
+            if deficit > 0 and not self._alloc_or_preempt(slot, deficit):
+                continue
+        dispatchable = [s for s in dispatchable if self.slots[s.index] is s]
+
+        # prefill chunk grants: one chunk per row, same head-of-line
+        # order and budget policy as the split path, same per-chunk fault
+        pre = [
+            s for s in self.slots
+            if s and s.phase == _PREFILL and s.filled < self.T
+        ]
+        pre.sort(key=lambda s: (
+            -self.sched.effective_priority(s.entry), s.admit_seq
+        ))
+        grants = self.budget.plan_iteration(
+            len(dispatchable), [self._next_chunk_fused(s.filled) for s in pre]
+        )
+        chunks: List[Tuple[_Slot, int]] = []
+        for slot, take in zip(pre, grants):
+            if not take:
+                continue
+            entry = slot.entry
+            if FAULTS.take("prefill_fail"):
+                self.counters.inc("serve.fault_prefill_fail")
+                entry.prefill_attempts += 1
+                self.counters.inc("serve.prefill_retries")
+                TELEMETRY.event(
+                    "serve.prefill_retry", request_id=entry.request_id,
+                    parent=self._req_spans.get(entry.request_id),
+                    attempt=entry.prefill_attempts, chunk_start=slot.filled,
+                )
+                if entry.prefill_attempts >= self.config.prefill_attempts:
+                    self._release_slot(slot)
+                    self._finish(
+                        entry, Outcome.PREFILL_FAILED, tokens=None,
+                        detail="prefill failed after "
+                               f"{entry.prefill_attempts} attempts "
+                               f"({slot.filled}/{self.T} tokens prefilled)",
+                    )
+                continue  # retry next iteration, from this same chunk
+            chunks.append((slot, self._next_chunk_fused(slot.filled)))
+
+        worked = False
+        with TELEMETRY.span(
+            "serve.iteration",
+            n_decode=len(dispatchable), n_prefill=len(chunks),
+            lookahead=cfg.decode_lookahead,
+        ) if (dispatchable or chunks) else contextlib.nullcontext():
+            new_pending = None
+            if dispatchable or chunks:
+                worked = True
+                new_pending = self._dispatch_fused(dispatchable, chunks,
+                                                   pending)
+            if cfg.decode_lookahead:
+                prev, self._pending = pending, new_pending
+            else:
+                prev, self._pending = new_pending, None
+            if prev is not None:
+                worked = True
+                self._fused_readback(prev)
+        return worked
+
+    def _dispatch_fused(self, dispatchable: List[_Slot],
+                        chunks: List[Tuple[_Slot, int]], pending):
+        """Dispatch one fused ragged iteration. Descriptor assembly only:
+        start/length/final vectors, fold-in keys for the rows whose
+        samples will be consumed (decode rows and final chunks), host
+        token scatter only for decode inputs not already on device."""
+        B = self.config.max_batch
+        start = np.zeros((B,), np.int32)
+        length = np.zeros((B,), np.int32)
+        final = np.zeros((B,), bool)
+        host_idx: List[int] = []
+        host_tok: List[int] = []
+        key_idx: List[int] = []
+        key_list = []
+        entries: List[Tuple[_Slot, str]] = []
+        for s in dispatchable:
+            start[s.index] = s.pos
+            length[s.index] = 1
+            key_idx.append(s.index)
+            key_list.append(jax.random.fold_in(
+                jax.random.key(s.entry.request.seed), s.pos + 1
+            ))
+            if pending is None or not s.tok_on_device:
+                host_idx.append(s.index)
+                host_tok.append(s.tok)
+            entries.append((s, _DECODE))
+        for s, c in chunks:
+            self.counters.inc("serve.prefill_chunks")
+            start[s.index] = s.filled
+            length[s.index] = c
+            if s.filled + c >= self.T:
+                final[s.index] = True
+                key_idx.append(s.index)
+                key_list.append(jax.random.fold_in(
+                    jax.random.key(s.entry.request.seed), self.T
+                ))
+                entries.append((s, _PREFILL))
+        if dispatchable:
+            self.counters.inc("serve.decode_steps")
+        tok = pending[0] if pending is not None else self._zero_tok
+        if host_idx:
+            tok = tok.at[jnp.asarray(host_idx)].set(
+                jnp.asarray(host_tok, jnp.int32)
+            )
+        keys = self._filler_keys
+        if key_idx:
+            keys = keys.at[jnp.asarray(key_idx)].set(jnp.stack(key_list))
+        self.dispatches += 1
+        self.counters.inc("serve.dispatches")
+        self.cache, samples = _iteration_jit(
+            self.dalle, self.params, self.cache, self._prompts,
+            tok, jnp.asarray(start), jnp.asarray(length), jnp.asarray(final),
+            keys, self._W, self.k_img, self.config.temperature,
+            bool(final.any()),
+        )
+        for s in self.slots:
+            if s is not None and s.phase == _DECODE:
+                s.tok_on_device = False
+        for s in dispatchable:
+            s.pos += 1
+            s.tok_on_device = True
+        for s, c in chunks:
+            s.filled += c
+            if final[s.index]:
+                # prefill complete at DISPATCH: the row's cache is fully
+                # written and its first image token is in the in-flight
+                # samples, so the slot transitions to the decode phase
+                # NOW — the next iteration dispatches it as a decode row
+                # whose input rides the pending sample array
+                # (tok_on_device), never visiting the host. The token
+                # VALUE lands in entry.generated at readback
+                # (_finish_prefill_fused).
+                TELEMETRY.end(s.prefill_span, outcome="completed")
+                s.prefill_span = None
+                s.phase = _DECODE
+                s.pos = self.T
+                s.tok_on_device = True
+        return samples, entries
+
+    def _fused_readback(self, prev) -> None:
+        """Apply one fused iteration's host decisions: record decode
+        tokens (dropping rows terminated since dispatch — at-readback-time
+        semantics, as in ``_readback``) and land final-chunk first tokens,
+        transitioning those slots to the decode phase."""
+        samples, entries = prev
+        samples = np.asarray(samples)
+        for s, kind in entries:
+            if self.slots[s.index] is not s:
+                continue  # terminated/evicted while the step was in flight
+            if kind == _DECODE:
+                s.tok = int(samples[s.index])
+                s.entry.generated.append(s.tok)
+                if len(s.entry.generated) >= s.entry.effective_max_new:
+                    self._complete(s)
+            else:
+                self._finish_prefill_fused(s, int(samples[s.index]))
+
+    def _finish_prefill_fused(self, slot: _Slot, tok0: int) -> None:
+        """Readback half of a fused prefill completion: the phase
+        transition (and the prefill span's end) happened at DISPATCH
+        (``_dispatch_fused``), and the slot may since have been
+        dispatched as a decode row with its own sample in flight — so
+        this records the token value and the TTFT, and must NOT touch
+        phase/pos/tok_on_device."""
+        entry = slot.entry
+        entry.generated = [tok0]
+        slot.tok = tok0
+        self._record_first_token(entry, self.clock.now())
         if len(entry.generated) >= entry.effective_max_new:
             self._complete(slot)
 
@@ -920,6 +1285,8 @@ class Engine:
         keys = self._filler_keys.at[jnp.asarray(key_idx)].set(
             jnp.stack(key_list)
         )
+        self.dispatches += 1
+        self.counters.inc("serve.dispatches")
         self.cache, samples = _decode_jit(
             self.dalle, self.params, self.cache,
             tok, jnp.asarray(pos), keys,
@@ -1011,10 +1378,11 @@ class Engine:
         tenant), page tables back to identity
         (``paged_kv.reset_table_rows``), and every other per-row leaf
         (indices, shift history) zeroed — the catch-all default, so a new
-        cache leaf is reset-safe by construction. A PREFILLING slot never
-        wrote its batched row (its chunks live in a private batch-1 cache,
-        dropped here), and ``insert_decode_cache`` overwrites every leaf
-        of the row at the next admission, so no device work is needed."""
+        cache leaf is reset-safe by construction. A SPLIT-mode PREFILLING
+        slot never wrote its batched row (its chunks live in a private
+        batch-1 cache, dropped here) so it skips the device reset; a
+        FUSED-mode prefilling slot wrote its chunks in place and resets
+        like a decoding slot."""
         self.pool.free_all(slot.entry.request_id)
         idx = slot.index
         if slot.phase == _PREFILL:
@@ -1024,8 +1392,14 @@ class Engine:
             slot.prefill_span = None
             slot.cache1 = None
             slot.internal = None
-            self.slots[idx] = None
-            return
+            if not self.fused:
+                # split mode: the chunks lived in a private batch-1 cache
+                # (dropped above); the batched row was never written
+                self.slots[idx] = None
+                return
+            # fused mode: the row's chunks were written straight into the
+            # batched cache — fall through to the same device reset a
+            # decoding slot gets
 
         def fn(path, x):
             key = getattr(path[-1], "key", None)
@@ -1148,8 +1522,13 @@ class Engine:
         if not idle:
             return
         assert not running_ids and not queued_ids, "engine not idle"
-        assert self._pending is None or not any(
-            self.slots[s.index] is s for s in self._pending[1]
+        # pending entries are bare slots (split) or (slot, kind) tuples
+        # (fused); normalize before the identity check
+        pending_slots = [] if self._pending is None else [
+            s[0] if isinstance(s, tuple) else s for s in self._pending[1]
+        ]
+        assert not any(
+            self.slots[s.index] is s for s in pending_slots
         ), "engine idle with a live in-flight decode step"
         assert self.pool.used == 0, (
             f"page leak: {self.pool.used} pages still held"

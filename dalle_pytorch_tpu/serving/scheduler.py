@@ -86,6 +86,35 @@ class TokenBudget:
                 granted_any = True
         return grants
 
+    def plan_iteration(self, n_decode: int, next_chunks: Sequence[int]) -> List[bool]:
+        """Which in-progress prefills run their next chunk in a FUSED
+        iteration (serving/engine.py:_iteration_jit).
+
+        The fused engine processes AT MOST ONE chunk per prefilling row
+        per iteration — a row is one fixed-width block of the single
+        ragged dispatch — but runs every granted chunk in the SAME
+        dispatch instead of the split path's sequential head-of-line
+        chunk jits. ``next_chunks``: width of each prefill's next chunk,
+        in scheduling order. Decode is charged first (one token per
+        active slot, exactly like ``plan``); the head prefill keeps the
+        forward-progress floor (granted even when decode exhausted the
+        budget); granting stops at the FIRST chunk that does not fit —
+        strict head-of-line, like ``plan``: letting a smaller
+        lower-priority chunk skip ahead would invert the priority order
+        the split path preserves."""
+        take = [False] * len(next_chunks)
+        if not next_chunks:
+            return take
+        if self.budget is None:
+            return [True] * len(next_chunks)
+        left = self.budget - n_decode
+        for i, c in enumerate(next_chunks):
+            if i > 0 and left < c:
+                break
+            take[i] = True
+            left -= c
+        return take
+
 
 class PagePool:
     """Logical page budget with per-request ownership. ``alloc`` is

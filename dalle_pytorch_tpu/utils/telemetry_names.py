@@ -37,7 +37,8 @@ SPANS = frozenset({
     "serve.prefill",        # monolithic, or cross-iteration when chunked
     "serve.prefill_chunk",  # one per chunk, synced in-span
     "serve.slot_insert",
-    "serve.decode_step",    # one per DISPATCHED decode step
+    "serve.decode_step",    # one per DISPATCHED decode step (split mode)
+    "serve.iteration",      # one per fused ragged iteration (one dispatch)
     # replicated front door (serving/router.py)
     "router.request",       # router submit -> typed outcome
     # trainer (train_dalle.py)
@@ -95,6 +96,7 @@ COUNTERS = frozenset({
     "serve.clamped",
     "serve.preempted",
     "serve.decode_steps",
+    "serve.dispatches",     # model-jit dispatches (fused: 1/iteration)
     "serve.prefill_chunks",
     "serve.prefill_retries",
     "serve.fault_request_cancel",

@@ -201,13 +201,18 @@ def test_masked_own_key_with_extreme_score():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
-def test_lane_packed_decode_matches_forward_default_path():
-    """The DEFAULT decode path's lane-packed sweeps (attention.py:
-    _decode_attend, taken when 128 % dim_head == 0 and heads divide into
-    full tiles) must reproduce the full-forward logits — independent of the
-    opt-in fused kernel, which stays off here."""
+def test_lane_packed_decode_matches_forward_default_path(monkeypatch):
+    """The TPU decode path's lane-packed sweeps (attention.py:
+    _cache_attend, taken when 128 % dim_head == 0 and heads divide into
+    full tiles) must reproduce the full-forward logits — independent of
+    the opt-in fused kernel, which stays off here. Forced on via
+    DALLE_TPU_LANE_PACK=1: the pack is TPU-gated by default (its
+    regrouped contraction is ~1 ulp off the plain gemm at some head
+    counts, and the CPU tier carries the fused-vs-split bit-parity
+    gates; tests/test_ragged_attention.py)."""
     import dalle_pytorch_tpu.ops.decode_attention as DK
 
+    monkeypatch.setenv("DALLE_TPU_LANE_PACK", "1")
     assert not DK.FUSED_DECODE_ENABLED  # default path under test
     dalle = _kernel_dalle()  # heads=2, dim_head=64 -> packed branch
     rng = np.random.RandomState(5)

@@ -572,9 +572,10 @@ def test_telemetry_smoke_gate(tmp_path):
     summary = json.loads(
         [l for l in out.stdout.splitlines() if l.startswith('{"flight_file')][0]
     )
-    # 3 chunked + 3 monolithic completions, 1 mid-prefill deadline drill
+    # 3 chunked + 3 monolithic + 3 fused completions, 1 mid-prefill
+    # deadline drill
     assert summary["request_outcomes"] == {
-        "completed": 6, "deadline_exceeded": 1,
+        "completed": 9, "deadline_exceeded": 1,
     }
     assert summary["prefill_chunk_spans"] >= 2
     assert summary["interference_max_gap_ms"] > 0

@@ -163,6 +163,38 @@ def build_entry_points() -> List[EntryPoint]:
             ],
         ),
         EntryPoint(
+            name="serving.iteration",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_iteration_jit",
+            fn=eng._iteration_jit,
+            lower=eng._iteration_jit.lower,
+            static_argnums=(0, 9, 10, 12),
+            donate={"cache": 2},
+            # the fused ragged iteration: descriptor raggedness is DATA,
+            # so every steady prefill/decode mix is EXACTLY the "steady"
+            # signature; "final" is the one additional class (iterations
+            # containing a FINAL chunk run the per-row split-parity
+            # heads — any_final is a host-known static). Both compile at
+            # warmup; anything beyond these two is the
+            # shape-drift-recompile bug class
+            signatures=[
+                Signature(
+                    "steady",
+                    (dalle, params, cacheB, SDS((B, T), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.bool_), keysB,
+                     cfg.prefill_chunk, k_img, 1.0, False),
+                ),
+                Signature(
+                    "final",
+                    (dalle, params, cacheB, SDS((B, T), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.bool_), keysB,
+                     cfg.prefill_chunk, k_img, 1.0, True),
+                ),
+            ],
+        ),
+        EntryPoint(
             name="serving.decode",
             path="dalle_pytorch_tpu/serving/engine.py",
             symbol="_decode_jit",

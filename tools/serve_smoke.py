@@ -2,15 +2,16 @@
 """Serving-engine release gate: continuous-batching passes on CPU.
 
 Builds a tiny DALLE in-process (no checkpoint needed) and drives the full
-engine lifecycle twice — once with CHUNKED prefill (budget-bounded
-prompt chunks interleaved with decode; the production serving shape) and
-once monolithic — verifying the accounting invariant each time: every
-request ends in a typed outcome, all pages return to the pool, and the
-two modes produce BIT-identical tokens. A third, deterministic drill
-(FakeClock) lands a deadline MID-PREFILL and asserts the pages come back
-that iteration. Exit 0 iff all requests of both passes COMPLETE and the
-drill terminates typed — the gate a release pipeline runs before
-shipping a serving build::
+engine lifecycle three times — CHUNKED prefill (budget-bounded prompt
+chunks interleaved with decode; the production serving shape),
+monolithic, and FUSED (the whole iteration as one ragged
+``_iteration_jit`` dispatch; ROADMAP 1) — verifying the accounting
+invariant each time: every request ends in a typed outcome, all pages
+return to the pool, and all three modes produce BIT-identical tokens.
+A further deterministic drill (FakeClock) lands a deadline MID-PREFILL
+and asserts the pages come back that iteration. Exit 0 iff all requests
+of all three passes COMPLETE and the drill terminates typed — the gate
+a release pipeline runs before shipping a serving build::
 
     python tools/serve_smoke.py
 
@@ -201,17 +202,31 @@ def main(argv=None) -> int:
     # and must be absorbed by the resume-from-last-chunk retry
     chunked = run_pass("chunked", prefill_chunk=2)
     mono = run_pass("monolithic")
+    # fused ragged-iteration pass (ROADMAP 1): the whole iteration — every
+    # granted chunk plus the decode rows — as ONE _iteration_jit dispatch;
+    # tokens must be BIT-identical to both split passes. Runs after the
+    # split passes so an env-armed fault budget drills the split chunk
+    # retry first, but composes with DALLE_TPU_FAULTS the same way
+    # (chunk-granular prefill_fail with resume-from-last-chunk)
+    fused = run_pass("fused", prefill_chunk=2, fused_iteration=True)
 
     ok = True
     for rid in sorted(mono):
         ok = ok and mono[rid].outcome is Outcome.COMPLETED
         ok = ok and chunked[rid].outcome is Outcome.COMPLETED
+        ok = ok and fused[rid].outcome is Outcome.COMPLETED
         if not np.array_equal(
             np.asarray(mono[rid].tokens), np.asarray(chunked[rid].tokens)
         ):
             ok = False
             print(f"serve smoke FAILED: {rid} chunked tokens diverge from "
                   "monolithic", file=sys.stderr)
+        if not np.array_equal(
+            np.asarray(mono[rid].tokens), np.asarray(fused[rid].tokens)
+        ):
+            ok = False
+            print(f"serve smoke FAILED: {rid} fused tokens diverge from "
+                  "the split path", file=sys.stderr)
 
     # mid-prefill deadline drill: token_budget=1 throttles prefill to one
     # chunk per iteration (the forward-progress floor), the FakeClock makes
@@ -247,7 +262,7 @@ def main(argv=None) -> int:
     if not ok:
         print("serve smoke FAILED: not every request completed", file=sys.stderr)
         return 1
-    print("serve smoke OK: 3/3 completed chunked AND monolithic "
+    print("serve smoke OK: 3/3 completed chunked, monolithic AND fused "
           "(bit-identical), mid-prefill deadline drill typed, pool drained"
           + (f", {2 * n_replicas}/{2 * n_replicas} completed the "
              f"{n_replicas}-replica crash drill bit-identically"
