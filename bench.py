@@ -99,6 +99,7 @@ def serving_jit_signatures() -> dict:
         "prefill_last": _engine._prefill_last_jit,
         "decode": _engine._decode_jit,
         "iteration": _engine._iteration_jit,
+        "sample_cached": _engine._sample_cached_jit,
         "decode_tokens": _sampling.decode_tokens,
     }
     out = {}
@@ -858,6 +859,208 @@ def bench_serve_interference(on_cpu: bool, int8: bool | None = None,
         "prompt_positions": T,
         "steady_max_new_tokens": steady_new,
         "arrival_seed": seed,
+        "device": jax.devices()[0].device_kind,
+    }
+
+
+def bench_serve_prefix(on_cpu: bool, int8: bool | None = None, seed: int = 0,
+                       model=None):
+    """--serve companion: the cross-request prefix-cache record (ROADMAP
+    3, serving/prefix_cache.py). A seeded ZIPF-OF-PREFIXES arrival trace
+    — a small pool of prompt templates drawn with zipf popularity, the
+    production shape of templated text-to-image traffic — runs through
+    one chunked engine with the content-addressed page index on, and the
+    record reports the cache-hit rate, pages deduplicated at publish,
+    and TTFT p50/p95 split cached-vs-cold (the ``serve.ttft_full_hit_s``
+    / ``serve.ttft_cold_s`` histograms). Acceptance runs IN-BENCH:
+
+      * hit rate > 0.5 (the zipf head re-uses its templates);
+      * full-hit TTFT p50 strictly beats cold TTFT p50 — the cached
+        admission pays one cached-logits sample where cold pays the
+        whole chunked prefill;
+      * cache-hit tokens are BIT-identical to the template's cold run:
+        every request of a template carries the template's seed, so the
+        cold first occurrence and every later hit must sample the same
+        token sequence (the deeper split/fused/COW/preemption parity
+        matrix lives in tests/test_prefix_cache.py);
+      * the timed trace performs ZERO jit recompiles and ZERO backend
+        compiles (PR 8 listener) — warm-up pays for the full-hit
+        admission ops and ``_sample_cached_jit`` per slot index.
+
+    ``int8`` defaults to bf16 on CPU (the head-dequant CPU artifact the
+    sibling records document); ``model`` overrides the flagship serving
+    model (tests pass a tiny one)."""
+    from dalle_pytorch_tpu.ops import kv_policy
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, Outcome, Request, check_accounting, pages_for,
+    )
+    from dalle_pytorch_tpu.utils.metrics import counters, histograms
+
+    if int8 is None:
+        int8 = not on_cpu
+    if model is None:
+        dalle, params, _, fmap = _serving_model(on_cpu, int8)
+    else:
+        dalle, params = model
+        fmap = dalle.image_fmap_size
+    T = dalle.text_len_internal
+    chunk = max(2, T // 16)
+    n_req = 9 if on_cpu else 48
+    n_templates = 3 if on_cpu else 6
+    max_batch = 2 if on_cpu else 8
+    max_new = min(fmap * fmap, 4 if on_cpu else 32)
+    zipf_exponent = 1.2
+    rng = np.random.RandomState(seed)
+    vocab = min(NUM_TEXT, dalle.num_text_tokens)
+    templates = rng.randint(
+        1, vocab, size=(n_templates, dalle.text_seq_len)
+    ).astype(np.int32)
+    # zipf popularity over template ranks; the first n_templates requests
+    # are the forced cold first-occurrences (every template gets a clean
+    # cold TTFT sample), the tail is the zipf draw
+    w = 1.0 / np.arange(1, n_templates + 1) ** zipf_exponent
+    draws = rng.choice(n_templates, size=n_req - n_templates, p=w / w.sum())
+    prompt_pages = pages_for(T, kv_policy.page_size())
+
+    engine = Engine(dalle, params, EngineConfig(
+        max_batch=max_batch, prefill_chunk=chunk, prefix_cache=True,
+        # headroom: every template chain + the warm chain stay resident
+        prefix_cache_pages=(n_templates + 2) * prompt_pages,
+    ))
+
+    def submit(template, rid):
+        rejected = engine.submit(Request(
+            request_id=rid, prompt=templates[template] if template >= 0
+            else np.zeros(dalle.text_seq_len, np.int32),
+            max_new_tokens=max_new if template >= 0 else 2,
+            # the template's OWN seed: cold first occurrence and every
+            # later cache hit must sample identical tokens (in-bench
+            # bit-parity)
+            seed=seed * 7919 + (template if template >= 0 else -1),
+        ))
+        assert rejected is None, (rid, rejected)
+
+    # warm-up, outside the timed trace: two concurrent cold requests
+    # publish the warm chain and exercise both slot indices' insert ops;
+    # then two concurrent FULL HITS warm _sample_cached_jit, the hit
+    # admission's table-write ops and the COW copy for both slots, and
+    # the dedup publish path
+    for phase in range(2):
+        for i in range(2):
+            submit(-1, f"__warm{phase}{i}__")
+        engine.run()
+    sig0, bc0 = serving_jit_signatures(), backend_compiles()
+    histograms.reset()  # TTFT percentiles cover the timed trace only
+    hits0 = counters.get("serve.prefix.hits")
+    miss0 = counters.get("serve.prefix.misses")
+    dedup0 = counters.get("serve.prefix.pages_deduped")
+    cow0 = counters.get("serve.prefix.cow_copies")
+
+    t0 = engine.clock.now()
+    # cold phase: each template's first occurrence runs to completion
+    # (publish included) before the next — clean cold TTFT samples, no
+    # publisher races
+    for t in range(n_templates):
+        submit(t, f"cold{t}")
+        engine.run()
+    # zipf phase: staggered submits (by iteration count — deterministic
+    # admission schedule) so hits overlap decode like production traffic
+    i0 = engine.iterations
+    submitted = 0
+    while True:
+        while submitted < len(draws) and (
+            submitted == 0
+            or engine.iterations - i0 >= submitted * 2
+        ):
+            submit(int(draws[submitted]), f"zipf{submitted}")
+            submitted += 1
+        if not engine.step():
+            if submitted >= len(draws):
+                break
+            submit(int(draws[submitted]), f"zipf{submitted}")
+            submitted += 1
+    wall = engine.clock.now() - t0
+    check_accounting(engine)
+    engine.verify_invariants(idle=True)
+    sig1, bc1 = serving_jit_signatures(), backend_compiles()
+
+    probes = (
+        counters.get("serve.prefix.hits") - hits0
+        + counters.get("serve.prefix.misses") - miss0
+    )
+    hit_rate = (counters.get("serve.prefix.hits") - hits0) / max(probes, 1)
+    pages_deduped = counters.get("serve.prefix.pages_deduped") - dedup0
+    cow_copies = counters.get("serve.prefix.cow_copies") - cow0
+    compiles_trace = bc1 - bc0 if bc0 >= 0 else -1
+    recompiles = _sig_delta(sig1, sig0)
+
+    def pct(name, q):
+        h = histograms.get(name)
+        return None if h is None or not h.count else round(
+            h.percentile(q) * 1e3, 2
+        )
+
+    ttft_cached_p50 = pct("serve.ttft_full_hit_s", 50)
+    ttft_cold_p50 = pct("serve.ttft_cold_s", 50)
+
+    # in-bench acceptance
+    by_template: dict = {}
+    for r in engine.results.values():
+        if r.request_id.startswith("__warm"):
+            continue
+        assert r.outcome is Outcome.COMPLETED, (r.request_id, r.outcome)
+        t = int(draws[int(r.request_id[4:])]) if r.request_id.startswith(
+            "zipf") else int(r.request_id[4:])
+        by_template.setdefault(t, []).append(np.asarray(r.tokens))
+    for t, seqs in by_template.items():
+        for s in seqs[1:]:
+            assert np.array_equal(seqs[0], s), (
+                f"template {t}: cache-hit tokens diverged from the cold run"
+            )
+    assert hit_rate > 0.5, (
+        f"zipf trace hit rate {hit_rate:.3f} <= 0.5 — the index is not "
+        "absorbing the template head"
+    )
+    assert ttft_cached_p50 is not None and ttft_cold_p50 is not None
+    assert ttft_cached_p50 < ttft_cold_p50, (
+        f"full-hit TTFT p50 {ttft_cached_p50}ms did not beat cold "
+        f"{ttft_cold_p50}ms"
+    )
+    assert compiles_trace in (0, -1), (
+        f"zipf timed trace compiled {compiles_trace} modules"
+    )
+    assert all(v in (0, -1) for v in recompiles.values()), (
+        f"zipf timed trace recompiled serving jits: {recompiles}"
+    )
+
+    return {
+        "metric": f"serve_prefix_hit_rate_batch{max_batch}"
+                  + ("_int8" if int8 and model is None else ""),
+        "int8": bool(int8),
+        "value": round(hit_rate, 4),
+        "unit": "hit_fraction",
+        "vs_baseline": None,
+        "hit_rate": round(hit_rate, 4),
+        "pages_deduped": int(pages_deduped),
+        "cow_copies": int(cow_copies),
+        "index_pages_resident": len(engine.prefix),
+        "ttft_cached_p50_ms": ttft_cached_p50,
+        "ttft_cached_p95_ms": pct("serve.ttft_full_hit_s", 95),
+        "ttft_cold_p50_ms": ttft_cold_p50,
+        "ttft_cold_p95_ms": pct("serve.ttft_cold_s", 95),
+        "ttft_source": "serve.ttft_full_hit_s / serve.ttft_cold_s "
+                       "histograms (utils/metrics.py), timed trace only",
+        "compiles_in_trace": compiles_trace,
+        "jit_recompiles_in_trace": recompiles,
+        "wall_s": round(wall, 3),
+        "n_requests": n_req,
+        "n_templates": n_templates,
+        "zipf_exponent": zipf_exponent,
+        "prefill_chunk": chunk,
+        "max_new_tokens": max_new,
+        "prompt_pages": prompt_pages,
+        "arrival_seed": seed,
+        "max_batch": max_batch,
         "device": jax.devices()[0].device_kind,
     }
 
@@ -1701,6 +1904,7 @@ def main():
             print(json.dumps(_retry(lambda: bench_serve(on_cpu))))
             print(json.dumps(_retry(lambda: bench_serve_fused(on_cpu))))
             print(json.dumps(_retry(lambda: bench_serve_interference(on_cpu))))
+            print(json.dumps(_retry(lambda: bench_serve_prefix(on_cpu))))
             if "--replicas" in sys.argv:
                 n = int(sys.argv[sys.argv.index("--replicas") + 1])
                 print(json.dumps(_retry(

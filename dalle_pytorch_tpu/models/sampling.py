@@ -128,12 +128,30 @@ def merge_decode_caches(caches):
                 "spatial-gate history indexes by a scalar absolute position"
             )
 
-    def merge(*leaves):
+    row_offsets = []
+    total = 0
+    for c in caches:
+        row_offsets.append(total)
+        total += {
+            x.shape[0]
+            for p, x in jax.tree_util.tree_leaves_with_path(c)
+            if getattr(p[-1], "key", None) == "cached_key_pages"
+        }.pop()
+
+    def merge(path, *leaves):
         if leaves[0].ndim == 0:
             return jnp.stack(leaves)
+        if getattr(path[-1], "key", None) == "page_table":
+            # tables hold GLOBAL ids (row * n_pages + page); each cache's
+            # rows land at a new row offset in the merged pool, so its
+            # row-local references shift by offset * n_pages
+            n_p = leaves[0].shape[1]
+            leaves = [
+                t + off * n_p for t, off in zip(leaves, row_offsets)
+            ]
         return jnp.concatenate(leaves, axis=0)
 
-    return jax.tree_util.tree_map(merge, *caches)
+    return jax.tree_util.tree_map_with_path(merge, *caches)
 
 
 def insert_decode_cache(batched, sub, slot: int):
@@ -166,10 +184,16 @@ def insert_decode_cache(batched, sub, slot: int):
                 "on the prefilled cache first"
             )
 
-    def fn(b_leaf, s_leaf):
-        return b_leaf.at[slot].set(s_leaf[0])
+    def fn(path, b_leaf, s_leaf):
+        row = s_leaf[0]
+        if getattr(path[-1], "key", None) == "page_table":
+            # global-id rebase: the batch-1 cache's table references its
+            # own (only) storage row; at slot ``slot`` those pages live
+            # ``slot * n_pages`` further into the batched pool's flat view
+            row = row + slot * b_leaf.shape[1]
+        return b_leaf.at[slot].set(row)
 
-    return jax.tree_util.tree_map(fn, batched, sub)
+    return jax.tree_util.tree_map_with_path(fn, batched, sub)
 
 
 @partial(jax.jit, static_argnums=(0, 5, 8, 9, 10, 11))
@@ -331,15 +355,14 @@ def _decode_tokens_body(
                         x, [(0, 0), (0, n_p - x.shape[1]), (0, 0), (0, 0)]
                     )
             elif key == "page_table":
-                cur = x.shape[1]
-                if cur > n_p:
-                    return x[:, :n_p]
-                if cur < n_p:
-                    grown = jnp.broadcast_to(
-                        jnp.arange(cur, n_p, dtype=x.dtype)[None],
-                        (x.shape[0], n_p - cur),
+                # tables hold GLOBAL ids r * n_pages + i whose stride is
+                # the pool's page axis — resizing the pool changes the
+                # stride, so the identity is REBUILT, not sliced/extended
+                # (identity is the in-jit invariant; ops/paged_kv.py)
+                if x.shape[1] != n_p:
+                    return paged_kv.identity_table(x.shape[0], n_p).astype(
+                        x.dtype
                     )
-                    return jnp.concatenate((x, grown), axis=1)
             return x
 
         return jax.tree_util.tree_map_with_path(fn, cache)

@@ -22,6 +22,20 @@ layout is then a property of the cache, not of the batch size:
   the seam a serving layer needs for page reuse / prefix sharing across
   requests without recompiling.
 
+Tables hold GLOBAL physical page ids (PR 10): entry (b, l) names page
+``g`` of the FLATTENED (b * n_pages, page_size, feat) pool view —
+``g = row * n_pages + p`` for the identity mapping — so a table entry can
+reference a page that physically lives in ANOTHER batch row's storage.
+That is what makes cross-request prefix sharing a page-table indirection
+(serving/prefix_cache.py maps a cache-hit request's prompt pages at the
+publisher's physical pages, refcounted, copy-on-write on divergence)
+instead of a cache redesign. Identity-mapped callers (every in-jit user:
+generation, training-free decode, the batch-1 prefill caches) see
+bit-identical behavior — the gather/append arithmetic only reshapes the
+pool view, never the data. Sharded serving note: a pjit-sharded pool
+would keep tables row-local (a global gather crosses shards); the
+single-device serving engine is the consumer of the global form.
+
 Two XLA formulations of the page gather were built and measured (CPU,
 this box, 2026-08; pools (8, 10, 128, 1024) bf16, jitted, best of 50):
 
@@ -88,14 +102,25 @@ def alloc(
 
 
 def identity_table(batch: int, n_pages: int) -> jnp.ndarray:
-    """(batch, n_pages) page table mapping logical page i -> physical page i
-    within the sequence's own pool row. Identity is the invariant every
-    in-jit user keeps (resize_kv relies on it to truncate/grow pools and
-    tables in lockstep); a serving layer remapping pages would manage its
-    own tables."""
-    return jnp.broadcast_to(
-        jnp.arange(n_pages, dtype=jnp.int32)[None], (batch, n_pages)
+    """(batch, n_pages) page table mapping logical page i of row r to
+    GLOBAL physical page ``r * n_pages + i`` — row r's own i-th page in
+    the flattened pool view. Identity is the invariant every in-jit user
+    keeps (resize_kv rebuilds it to truncate/grow pools and tables in
+    lockstep); the serving layer's prefix cache
+    (serving/prefix_cache.py) is the one consumer that remaps entries
+    across rows."""
+    return (
+        jnp.arange(batch, dtype=jnp.int32)[:, None] * n_pages
+        + jnp.arange(n_pages, dtype=jnp.int32)[None]
     )
+
+
+def flat_view(pool: jnp.ndarray) -> jnp.ndarray:
+    """The (rows * n_pages, page, feat) GLOBAL view of a pool — the id
+    space page tables index. A pure reshape (no data movement): physical
+    page ``g`` is row ``g // n_pages``'s page ``g % n_pages``."""
+    rows, n_p, page, feat = pool.shape
+    return pool.reshape(rows * n_p, page, feat)
 
 
 def append(
@@ -106,8 +131,12 @@ def append(
     limit: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """Write ``rows`` (b, n, feat) at per-sequence positions
-    ``index`` (b,) .. index+n into the paged ``pool`` (b, n_pages, page, feat)
-    through ``table`` (b, n_pages). Returns the updated pool.
+    ``index`` (b,) .. index+n into the paged ``pool`` (rows, n_pages, page,
+    feat) through ``table`` (b, n_pages) holding GLOBAL physical page ids.
+    Returns the updated pool. ``pool`` may carry MORE storage rows than the
+    table has sequences (the serving engine's prefix-cache arena rides as
+    extra rows addressable only through remapped table entries); the
+    sequence batch is the TABLE's.
 
     Positions may cross page boundaries mid-block (a prefill block spans
     ceil(n/page) pages); each row lands in page ``pos // page`` at offset
@@ -121,58 +150,111 @@ def append(
     receives the same padded (b, n, feat) block, but a decode row commits
     one position, a prefill chunk its own width, an idle row nothing.
     """
-    b, n_p, page, feat = pool.shape
+    n_rows, n_p, page, feat = pool.shape
+    l_pages = table.shape[1]
     n = rows.shape[1]
     pos = index[:, None] + jnp.arange(n, dtype=index.dtype)[None, :]  # (b, n)
     logical = pos // page
     off = pos % page
-    phys = jnp.take_along_axis(table, jnp.minimum(logical, n_p - 1), axis=1)
+    phys = jnp.take_along_axis(table, jnp.minimum(logical, l_pages - 1), axis=1)
     # drop (not clamp) genuinely out-of-capacity rows
-    valid = logical < n_p
+    valid = logical < l_pages
     if limit is not None:
         valid = valid & (
             jnp.arange(n, dtype=jnp.int32)[None, :] < limit[:, None]
         )
-    phys = jnp.where(valid, phys, n_p)
-    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, n))
-    return pool.at[bidx, phys, off].set(rows, mode="drop")
+    phys = jnp.where(valid, phys, n_rows * n_p)  # OOB sentinel, mode="drop"
+    flat = flat_view(pool).at[phys, off].set(rows, mode="drop")
+    return flat.reshape(pool.shape)
 
 
 def reset_rows(pool: jnp.ndarray, rows) -> jnp.ndarray:
-    """Zero the page pools of the given batch rows — the eviction reset.
+    """Zero the page pools of the given SLOT rows — the eviction reset.
 
     A preempted/completed request's pages must not leak stale K/V into the
     slot's next tenant: the serving engine re-prefills the slot from scratch,
     and prefill only overwrites positions [0, T), so stale rows beyond the
     new request's frontier would otherwise survive under the (zeros-masked)
     attention sweep contract. ``rows`` is an int row index or a sequence of
-    them; works on any (b, ...) pool-shaped leaf."""
+    them; works on any (b, ...) pool-shaped leaf.
+
+    Refcount discipline (serving/prefix_cache.py): this zeros a row's
+    NATIVE storage only. Shared prefix pages live in dedicated ARENA rows
+    past the slot rows and are reachable only through remapped table
+    entries, so evicting a slot that references refcounted shared pages
+    must pair this with ``reset_table_rows`` — dropping the REFERENCE —
+    and must never name an arena row here: arena content is owned by the
+    prefix index and reclaimed only by its own (refcount == 0) eviction.
+    The engine asserts the row bound (``Engine._release_slot``); the
+    sibling-bit-parity regression lives in tests/test_prefix_cache.py."""
     return pool.at[jnp.asarray(rows)].set(0)
 
 
 def reset_table_rows(table: jnp.ndarray, rows) -> jnp.ndarray:
-    """Restore the identity mapping for the given batch rows of a page
-    table. Eviction hands the slot's physical pages back as a pristine
-    identity-mapped pool (the invariant every in-jit user keeps — see
-    ``identity_table``); a serving layer doing cross-slot page remapping
-    would manage its own tables instead."""
+    """Restore the identity mapping (global ids ``r * n_pages + i``) for
+    the given batch rows of a page table. Eviction hands the slot's own
+    physical pages back as a pristine identity-mapped pool (the invariant
+    every in-jit user keeps — see ``identity_table``) and, for a slot
+    holding shared prefix pages, DROPS the cross-row references without
+    touching the shared storage (the refcount-only half of the eviction;
+    see ``reset_rows``). The identity stride is ``table.shape[1]``: the
+    pool's page axis must equal the table's logical width (arena capacity
+    extends the pool's ROW axis, never its page axis)."""
     b, n_p = table.shape
-    ident = jnp.arange(n_p, dtype=table.dtype)
-    return table.at[jnp.asarray(rows)].set(ident)
+    r = jnp.atleast_1d(jnp.asarray(rows, dtype=table.dtype))
+    ident = r[:, None] * n_p + jnp.arange(n_p, dtype=table.dtype)[None]
+    return table.at[r].set(ident)
+
+
+def copy_pages_across(
+    dst_pool: jnp.ndarray, src_pool: jnp.ndarray, src, dst, valid=None
+) -> jnp.ndarray:
+    """Copy whole physical pages ``src`` (global ids into ``src_pool``'s
+    flat view) onto pages ``dst`` of ``dst_pool``, zeroing destination
+    rows past ``valid`` (per-page valid row counts; None = all rows).
+    One gather + one scatter per call — the prefix cache's primitive for
+    publish (slot pages -> arena), copy-on-write (shared terminal page ->
+    the diverging slot's native page; same pool both sides, see
+    ``copy_pages``) and the split engine's hit restore (batched arena ->
+    a private batch-1 prefill cache). Destination rows at or past
+    ``valid[i]`` are ZEROED, not preserved: a published terminal page
+    must not leak the publisher's image K/V, and a COW'd page must
+    satisfy the zeros-past-frontier sweep contract even when the
+    destination page held stale content."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    content = flat_view(src_pool)[src]  # (k, page, feat)
+    if valid is not None:
+        page = src_pool.shape[2]
+        keep = (
+            jnp.arange(page, dtype=jnp.int32)[None]
+            < jnp.asarray(valid, jnp.int32)[:, None]
+        )
+        content = jnp.where(keep[..., None], content, 0)
+    return flat_view(dst_pool).at[dst].set(content).reshape(dst_pool.shape)
+
+
+def copy_pages(pool: jnp.ndarray, src, dst, valid=None) -> jnp.ndarray:
+    """``copy_pages_across`` within one pool — see its docstring."""
+    return copy_pages_across(pool, pool, src, dst, valid)
 
 
 def gather(pool: jnp.ndarray, table: jnp.ndarray, variant=None) -> jnp.ndarray:
-    """Assemble the logical cache view (b, n_pages * page, feat) from the
-    paged pool. The ``take`` variant is the production path (the row gather
-    fuses into the consuming einsum); ``onehot`` is the measured-slower
-    MXU formulation kept for TPU re-measurement — numbers in the module
-    docstring."""
-    b, n_p, page, feat = pool.shape
+    """Assemble the logical cache view (b, l_pages * page, feat) from the
+    paged pool through a GLOBAL-id table (b, l_pages) — a table entry may
+    name a page in ANY storage row, which is what lets the serving prefix
+    cache map one physical page into many sequences' views. The ``take``
+    variant is the production path (the row gather fuses into the
+    consuming einsum); ``onehot`` is the measured-slower MXU formulation
+    kept for TPU re-measurement — numbers in the module docstring."""
+    n_rows, n_p, page, feat = pool.shape
+    b, l_pages = table.shape
     if variant is None:
         variant = gather_variant()
+    flat = flat_view(pool)
     if variant == "onehot":
-        oh = jax.nn.one_hot(table, n_p, dtype=pool.dtype)  # (b, L_pages, n_p)
-        g = jnp.einsum("bln,bnpf->blpf", oh, pool)
+        oh = jax.nn.one_hot(table, n_rows * n_p, dtype=pool.dtype)
+        g = jnp.einsum("blg,gpf->blpf", oh, flat)
     else:
-        g = jnp.take_along_axis(pool, table[:, :, None, None], axis=1)
-    return g.reshape(b, n_p * page, feat)
+        g = jnp.take(flat, table, axis=0)  # (b, l_pages, page, feat)
+    return g.reshape(b, l_pages * page, feat)

@@ -105,8 +105,11 @@ def _ragged_kernel(
     *, heads, dim_head, page, n_pages, width,
 ):
     """One (row, page) grid step: q_ref (1, W, h*d) is row b's whole
-    padded block, k_ref/v_ref (1, 1, page, h*d) one physical page
-    (selected by the TABLE in the index map). Per-head dots with running
+    padded block, k_ref/v_ref (1, page, h*d) one physical page of the
+    FLATTENED (rows * n_pages, page, h*d) pool view — the table holds
+    GLOBAL page ids (ops/paged_kv.py), so a grid step can stream a page
+    that physically lives in another row's storage (or the prefix-cache
+    arena) — (selected by the TABLE in the index map). Per-head dots with running
     (max, denom, acc) scratch; analytic causal masking from the row's
     ``start`` descriptor; pages past the row's frontier skip compute
     (their DMA still streams — affine-in-j index maps keep Mosaic's
@@ -136,8 +139,8 @@ def _ragged_kernel(
         for h_ in range(heads):
             lo = h_ * dim_head
             qh = q_ref[0, :, lo:lo + dim_head]              # (W, d)
-            kh = k_ref[0, 0, :, lo:lo + dim_head]           # (page, d)
-            vh = v_ref[0, 0, :, lo:lo + dim_head]
+            kh = k_ref[0, :, lo:lo + dim_head]              # (page, d)
+            vh = v_ref[0, :, lo:lo + dim_head]
             s = jax.lax.dot_general(
                 qh, kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -168,39 +171,48 @@ def _ragged_kernel(
 
 def kernel_attend(q, k_pool, v_pool, table, start, length, interpret=False):
     """Pallas ragged paged attention, causal-"full" masking. q (b, n, h, d)
-    pre-scaled; returns (b, n, h, d). See the kernel docstring."""
+    pre-scaled; returns (b, n, h, d). The pools are streamed through their
+    FLATTENED (rows * n_pages, page, h*d) global view — the id space the
+    table indexes (ops/paged_kv.py) — so pools carrying prefix-cache arena
+    rows beyond the query batch work unchanged. See the kernel docstring."""
+    from . import paged_kv
+
     b, n, h, d = q.shape
     _, n_p, page, hd = k_pool.shape
+    l_pages = table.shape[1]
     assert hd == h * d, (k_pool.shape, (h, d))
     qf = q.reshape(b, n, hd)
+    k_flat = paged_kv.flat_view(k_pool)
+    v_flat = paged_kv.flat_view(v_pool)
     # descriptor payload: per-row [table row | start | length], int32 —
-    # the page index map dereferences s[b, j]; the kernel body reads the
-    # (start, length) tail
+    # the page index map dereferences s[b, j] (a GLOBAL page id into the
+    # flat view); the kernel body reads the (start, length) tail
     scalar = jnp.concatenate(
         (table.astype(jnp.int32), start[:, None].astype(jnp.int32),
          length[:, None].astype(jnp.int32)), axis=1,
     )
 
     kernel = functools.partial(
-        _ragged_kernel, heads=h, dim_head=d, page=page, n_pages=n_p,
+        _ragged_kernel, heads=h, dim_head=d, page=page, n_pages=l_pages,
         width=n,
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(b, n_p),
+            grid=(b, l_pages),
             in_specs=[
                 pl.BlockSpec((1, n, hd), lambda bi, j, s: (bi, 0, 0)),
                 # the page-table indirection: grid step (bi, j) streams
-                # PHYSICAL page table[bi, j] — the seam a prefix-sharing
-                # serving layer needs, at zero cost while tables are
-                # identity
+                # PHYSICAL page table[bi, j] of the flat view — possibly
+                # another row's storage or a shared prefix-cache arena
+                # page (serving/prefix_cache.py); each grid step still
+                # fetches a distinct page, preserving DMA pipelining
                 pl.BlockSpec(
-                    (1, 1, page, hd), lambda bi, j, s: (bi, s[bi, j], 0, 0)
+                    (1, page, hd), lambda bi, j, s: (s[bi, j], 0, 0)
                 ),
                 pl.BlockSpec(
-                    (1, 1, page, hd), lambda bi, j, s: (bi, s[bi, j], 0, 0)
+                    (1, page, hd), lambda bi, j, s: (s[bi, j], 0, 0)
                 ),
             ],
             out_specs=pl.BlockSpec((1, n, hd), lambda bi, j, s: (bi, 0, 0)),
@@ -216,15 +228,15 @@ def kernel_attend(q, k_pool, v_pool, table, start, length, interpret=False):
             dimension_semantics=("parallel", "arbitrary")
         ),
         cost_estimate=pl.CostEstimate(
-            flops=2 * b * h * n * n_p * page * d * 2,
-            transcendentals=b * h * n * n_p * page,
+            flops=2 * b * h * n * l_pages * page * d * 2,
+            transcendentals=b * h * n * l_pages * page,
             bytes_accessed=(
-                b * n_p * page * hd * 2 * k_pool.dtype.itemsize
+                b * l_pages * page * hd * 2 * k_pool.dtype.itemsize
                 + 2 * b * n * hd * q.dtype.itemsize
             ),
         ),
         interpret=interpret,
-    )(scalar, qf, k_pool, v_pool)
+    )(scalar, qf, k_flat, v_flat)
     return out.reshape(b, n, h, d)
 
 

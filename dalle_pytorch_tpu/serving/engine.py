@@ -119,6 +119,7 @@ from ..ops import kv_policy, paged_kv
 from ..utils.faults import FAULTS
 from ..utils.metrics import counters, gauges, histograms
 from ..utils.telemetry import TELEMETRY
+from .prefix_cache import PrefixCache, chain_blocks
 from .scheduler import Entry, PagePool, Scheduler, TokenBudget, pages_for
 from .types import (
     Clock,
@@ -172,10 +173,59 @@ class EngineConfig:
     # CPU parity tier
     # (tests/test_ragged_attention.py, tools/serve_smoke.py --fused).
     fused_iteration: bool = False
+    # cross-request prefix caching (serving/prefix_cache.py, ROADMAP 3):
+    # content-addressed immutable prompt pages with refcounts. A probe at
+    # admission maps every verified hit page into the slot's page table
+    # read-only; a FULL-prefix hit skips prefill entirely (first token
+    # sampled from the cached terminal logits) and a partial hit resumes
+    # chunked prefill at the miss boundary (chunked modes only — a
+    # monolithic engine serves full hits and falls back to cold
+    # otherwise). Shared page content lives in ARENA rows appended to
+    # the batched pools, reachable only through remapped table entries.
+    prefix_cache: bool = False
+    # arena capacity in pages; rounded UP to whole storage rows. None =
+    # four prompts' worth (a few distinct templates stay resident).
+    prefix_cache_pages: Optional[int] = None
 
 
 _PREFILL = "prefill"
 _DECODE = "decode"
+
+# PagePool holder id for pages owned by the prefix index (the logical
+# budget treats cached pages like any resident pages: droppable, but
+# accounted — the index is its own eviction tier)
+PREFIX_HOLDER = "__prefix__"
+
+
+class _AdmitHit:
+    """One admission's usable prefix-cache probe result: the verified
+    chain nodes the slot will consume (references already ACQUIRED —
+    every non-admission path must release), whether they cover the full
+    prompt, and how many pages the slot maps SHARED (demand shrinks by
+    exactly these; split-mode partial hits copy instead, so they share
+    none)."""
+
+    def __init__(self, nodes, full: bool = False, shared: int = 0):
+        self.nodes = nodes
+        self.full = full
+        self.shared = shared
+
+    @property
+    def n_pages(self) -> int:
+        return len(self.nodes)
+
+    @property
+    def kind(self):
+        if not self.nodes:
+            return None
+        return "full" if self.full else "partial"
+
+    @property
+    def coverage(self) -> int:
+        return self.nodes[-1].coverage if self.nodes else 0
+
+
+_NO_HIT = _AdmitHit(nodes=())
 
 
 class _Slot:
@@ -202,6 +252,16 @@ class _Slot:
         # True iff this slot's next input token is still on device in the
         # engine's pending (in-flight) sample array — the lookahead seam
         self.tok_on_device = False
+        # prefix-cache state (serving/prefix_cache.py): index nodes this
+        # slot maps read-only (refcounts held until release), ring-seam
+        # snapshots captured at page boundaries during prefill (keyed by
+        # boundary position; published with the pages at completion), and
+        # the terminal image-head logits for the full-prefix entry
+        self.shared_nodes: list = []
+        self.boundary_rings: dict = {}
+        self.final_logits = None
+        # boundary below which snapshots are pointless (already indexed)
+        self.snap_from = 0
 
 
 @partial(jax.jit, static_argnums=(0, 5), donate_argnums=(2,))
@@ -233,7 +293,10 @@ def _prefill_jit(dalle: DALLE, params, cache, internal_text, key, k: int,
     tok = jax.random.categorical(
         key, top_k_filter(img, k=k) / temperature, axis=-1
     )
-    return mutated["cache"], tok
+    # the raw last-position logits ride along for the prefix cache's
+    # terminal payload (a full-prefix hit re-samples from EXACTLY these
+    # values with its own key); unread when prefix caching is off
+    return mutated["cache"], tok, img
 
 
 @partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
@@ -270,7 +333,8 @@ def _prefill_last_jit(dalle: DALLE, params, cache, chunk, start, k: int,
     tok = jax.random.categorical(
         key, top_k_filter(img, k=k) / temperature, axis=-1
     )
-    return mutated["cache"], tok
+    # raw logits for the prefix cache's terminal payload (see _prefill_jit)
+    return mutated["cache"], tok, img
 
 
 @partial(jax.jit, static_argnums=(0, 6), donate_argnums=(2,))
@@ -338,7 +402,73 @@ def _iteration_jit(dalle: DALLE, params, cache, prompts, tok, start, length,
     )
     filtered = top_k_filter(logits, k=k) / temperature
     samples = jax.vmap(jax.random.categorical)(keys, filtered)
-    return mutated["cache"], samples.astype(jnp.int32)
+    if any_final:
+        # final-chunk iterations (already their own warm signature class)
+        # also surface the raw per-row logits: the prefix cache's terminal
+        # payload for rows completing their prefill this dispatch
+        return mutated["cache"], samples.astype(jnp.int32), logits
+    return mutated["cache"], samples.astype(jnp.int32), None
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _sample_cached_jit(logits, key, k: int, temperature):
+    """Sample a first image token from CACHED terminal prefill logits —
+    the full-prefix-hit path runs no prefill at all, so the exact
+    top-k/temperature/categorical op sequence of ``_prefill_jit``'s tail
+    re-runs here against the published logits values with the request's
+    own ``fold_in(key(seed), T)`` key. Elementwise + sort ops on
+    identical inputs, so the sampled token is bit-identical to the cold
+    run's on every platform (no matmul reassociation in this program)."""
+    return jax.random.categorical(
+        key, top_k_filter(logits, k=k) / temperature, axis=-1
+    )
+
+
+def _append_arena_rows(cache, rows: int):
+    """Append ``rows`` zeroed storage rows to every K/V page-pool leaf —
+    the prefix cache's arena. Tables, indices, and shift rings stay at
+    the slot batch width: arena pages hold CONTENT only, reachable
+    through remapped (global-id) table entries, never dispatched as
+    query rows. Pure; the trace registry reuses it under eval_shape so
+    the committed contract sees the same avals the engine runs."""
+    if rows <= 0:
+        return cache
+
+    def fn(path, x):
+        if getattr(path[-1], "key", None) in (
+            "cached_key_pages", "cached_value_pages"
+        ):
+            return jnp.pad(x, [(0, rows)] + [(0, 0)] * (x.ndim - 1))
+        return x
+
+    return jax.tree_util.tree_map_with_path(fn, cache)
+
+
+def arena_rows_for(prefix_cache_pages: Optional[int], prompt_pages: int,
+                   n_pages_slot: int) -> int:
+    """Arena sizing shared by ``Engine.__init__`` and the trace-audit
+    registry (tools/lint/trace/registry.py) — the ONE definition of how
+    many whole storage rows back a requested page budget, so the
+    committed contract derives its cache avals from the code, not from
+    a transcription of it. ``None`` requests the default: four prompts'
+    worth (a few distinct templates stay resident)."""
+    want = (
+        prefix_cache_pages if prefix_cache_pages is not None
+        else 4 * prompt_pages
+    )
+    return -(-max(1, want) // n_pages_slot)
+
+
+def _ring_snapshot(cache, row: int) -> dict:
+    """The shift-ring seam of one cache row: every layer's ``shift_hist``
+    slice, keyed by tree path (stable across batch widths, so a snapshot
+    from a batch-1 prefill cache restores into the batched cache and
+    vice versa). Lazy device slices — nothing syncs."""
+    out = {}
+    for path, x in jax.tree_util.tree_leaves_with_path(cache):
+        if getattr(path[-1], "key", None) == "shift_hist":
+            out[jax.tree_util.keystr(path)] = x[row]
+    return out
 
 
 class Engine:
@@ -385,10 +515,22 @@ class Engine:
         self.page = kv_policy.page_size()
         self.T = dalle.text_len_internal
         self.n_pages_slot = pages_for(self.T + dalle.image_seq_len, self.page)
+        # prefix-cache arena sizing: whole storage ROWS appended to the
+        # batched pools (global ids keep the identity stride == the
+        # table width; ops/paged_kv.py), so requested pages round up
+        self._arena_rows = 0
+        arena_pages = 0
+        if config.prefix_cache:
+            self._arena_rows = arena_rows_for(
+                config.prefix_cache_pages,
+                pages_for(self.T, self.page),
+                self.n_pages_slot,
+            )
+            arena_pages = self._arena_rows * self.n_pages_slot
         budget = (
             config.page_budget
             if config.page_budget is not None
-            else config.max_batch * self.n_pages_slot
+            else config.max_batch * self.n_pages_slot + arena_pages
         )
         self.pool = PagePool(budget)
         self.sched = Scheduler(
@@ -413,6 +555,18 @@ class Engine:
             init_decode_cache(dalle, params, B, cache_format="paged"),
             jnp.zeros((B,), jnp.int32),
         )
+        # prefix cache: arena rows appended to the POOL leaves only (page
+        # tables/indices stay B-wide — arena pages are reachable purely
+        # through remapped table entries), plus the host-side index over
+        # the arena's global page-id range
+        self.prefix: Optional[PrefixCache] = None
+        if config.prefix_cache:
+            self.cache = _append_arena_rows(self.cache, self._arena_rows)
+            n_p = self.n_pages_slot
+            arena_ids = range(B * n_p, (B + self._arena_rows) * n_p)
+            self.prefix = PrefixCache(list(arena_ids), self.page)
+        self._prefix_hits = 0
+        self._prefix_misses = 0
         # pristine batch-1 cache, the TEMPLATE every prefill starts from.
         # The prefill jits donate their cache argument (the output aliases
         # the input in HBM), so this template itself must never be passed
@@ -638,20 +792,32 @@ class Engine:
             # re-check demand against CURRENT free pages (strict
             # head-of-line; see Scheduler docstring for the starvation
             # rationale). Demand uses the clamped budget the request would
-            # actually get, so degradation widens the door it is sized for.
+            # actually get, so degradation widens the door it is sized
+            # for — and a prefix-cache hit SHRINKS it by the pages the
+            # slot will map shared instead of allocating (probe first:
+            # the hit length is part of the admission decision).
             eff_max_new, clamped = self._degraded_budget(entry)
-            if self._worst_case_pages(eff_max_new) > self.pool.free:
+            hit = self._probe_admission(entry)
+            demand = self._worst_case_pages(eff_max_new) - hit.shared
+            if demand > self.pool.free and not self._reclaim_index_pages(
+                demand - self.pool.free
+            ):
+                if hit.nodes:
+                    self.prefix.release(hit.nodes)
                 return
             entry = self.sched.pop()
             entry.effective_max_new = eff_max_new
             entry.clamped = clamped
             if clamped:
                 self.counters.inc("serve.clamped")
-            prompt_pages = pages_for(self.T, self.page)
+            prompt_pages = pages_for(self.T, self.page) - hit.shared
             ok = self.pool.alloc(entry.request_id, prompt_pages)
             assert ok, "admission checked worst-case > prompt pages"
+            if hit.full:
+                self._claim_full_hit_slot(entry, free[0], hit)
+                continue
             if self.config.prefill_chunk is not None:
-                self._claim_prefill_slot(entry, free[0])
+                self._claim_prefill_slot(entry, free[0], hit)
                 continue
             req_span = self._req_spans.get(entry.request_id)
             try:
@@ -660,7 +826,7 @@ class Engine:
                     request_id=entry.request_id, parent=req_span,
                     attempt=entry.prefill_attempts,
                 ):
-                    cache1, tok0 = self._prefill(entry)
+                    cache1, tok0, img = self._prefill(entry)
             except _PrefillFault:
                 self.pool.free_all(entry.request_id)
                 entry.prefill_attempts += 1
@@ -679,6 +845,9 @@ class Engine:
                     self.sched.requeue(entry)
                 continue
             idx = free[0]
+            ring = (
+                _ring_snapshot(cache1, 0) if self.prefix is not None else None
+            )
             with TELEMETRY.span(
                 "serve.slot_insert",
                 request_id=entry.request_id, parent=req_span, slot=idx,
@@ -700,16 +869,35 @@ class Engine:
                 admit_seq=self._admit_seq,
             )
             self._admit_seq += 1
+            if self.prefix is not None:
+                # monolithic prefill observes only the TERMINAL boundary
+                # (intermediate page states never surface to the host),
+                # so published interior nodes are content-only and the
+                # terminal node carries the full-hit payload
+                slot.boundary_rings[self.T] = ring
+                slot.final_logits = img
             self.slots[idx] = slot
             self.counters.inc("serve.admitted")
+            self._note_prefix_outcome(entry, hit, req_span, idx)
             self._record_first_token(entry, now)
             if len(entry.generated) >= entry.effective_max_new:
                 self._complete(slot)
 
-    def _claim_prefill_slot(self, entry: Entry, idx: int) -> None:
+    def _claim_prefill_slot(
+        self, entry: Entry, idx: int, hit: "_AdmitHit" = None
+    ) -> None:
         """Chunked-mode admission: the request claims its slot and prompt
         pages NOW; the prompt itself is processed chunk by chunk across the
-        following iterations (``_advance_prefills``)."""
+        following iterations (``_advance_prefills``). A PARTIAL prefix-
+        cache hit starts the chunk machinery at the miss boundary instead
+        of position 0: fused mode MAPS the hit pages into the slot's page
+        table read-only (refcounts held until release) and restores the
+        boundary's shift-ring seam in place; split mode COPIES the hit
+        pages into the private batch-1 cache (its chunk jits cannot reach
+        the batched pools) — compute is still skipped, the refs are
+        dropped once the copy is dispatched."""
+        if hit is None:
+            hit = _NO_HIT
         now = self.clock.now()
         entry.admit_time = now
         req_span = self._req_spans.get(entry.request_id)
@@ -724,24 +912,367 @@ class Engine:
             admit_seq=self._admit_seq, phase=_PREFILL,
         )
         self._admit_seq += 1
-        text = jnp.asarray(entry.request.prompt, jnp.int32)[None, :]
-        internal = self.dalle.remap_text(text)
+        internal = jnp.asarray(self._internal_tokens(entry), jnp.int32)[None]
+        nodes = hit.nodes
+        s = hit.coverage
         if self.fused:
             # fused mode: the row prefills IN PLACE in the batched cache
             # (reset to pristine at release), chunks gathered in-trace
             # from the prompts buffer — one small row write per admission
             self._prompts = self._prompts.at[idx].set(internal[0])
+            if nodes:
+                ids = jnp.asarray(
+                    [n.page_id for n in nodes], jnp.int32
+                )
+                ring = nodes[-1].ring
+
+                def fn(path, x):
+                    key = getattr(path[-1], "key", None)
+                    if key == "page_table":
+                        return x.at[idx, : len(nodes)].set(ids)
+                    if key in ("cache_index", "shift_index"):
+                        return x.at[idx].set(s)
+                    if key == "shift_hist":
+                        return x.at[idx].set(
+                            ring[jax.tree_util.keystr(path)]
+                        )
+                    return x
+
+                self.cache = jax.tree_util.tree_map_with_path(fn, self.cache)
+                slot.shared_nodes = list(nodes)
         else:
             slot.cache1 = self._fresh_prefill_cache()
             slot.internal = internal
-        slot.filled = 0
+            if nodes:
+                src = [n.page_id for n in nodes]
+                ring = nodes[-1].ring
+
+                def fn(path, x1, xb):
+                    key = getattr(path[-1], "key", None)
+                    if key in ("cached_key_pages", "cached_value_pages"):
+                        return paged_kv.copy_pages_across(
+                            x1, xb, src, list(range(len(src)))
+                        )
+                    if key == "shift_hist":
+                        return x1.at[0].set(
+                            ring[jax.tree_util.keystr(path)]
+                        )
+                    if key in ("cache_index", "shift_index"):
+                        # per-leaf fresh arrays (set_decode_offsets would
+                        # hand EVERY index leaf the same buffer — fatal
+                        # once the chunk jits donate this cache)
+                        return jnp.full((1,), s, x1.dtype)
+                    return x1
+
+                slot.cache1 = jax.tree_util.tree_map_with_path(
+                    fn, slot.cache1, self.cache
+                )
+                self.prefix.release(nodes)
+        slot.filled = s
+        slot.snap_from = s
         slot.prefill_span = TELEMETRY.begin(
             "serve.prefill",
             request_id=entry.request_id, parent=req_span,
-            attempt=entry.prefill_attempts, chunked=True,
+            attempt=entry.prefill_attempts, chunked=True, resumed_at=s,
         )
         self.slots[idx] = slot
         self.counters.inc("serve.admitted")
+        self._note_prefix_outcome(entry, hit, req_span, idx)
+
+    def _claim_full_hit_slot(
+        self, entry: Entry, idx: int, hit: "_AdmitHit"
+    ) -> None:
+        """FULL-prefix-hit admission: no prefill at all. Every cached
+        prompt page is mapped into the slot's table read-only, the
+        terminal shift-ring seam is restored, and the first image token
+        is sampled from the cached terminal logits with the request's own
+        ``fold_in(key(seed), T)`` key — bit-identical to the cold prefill
+        (``_sample_cached_jit``). A PARTIAL terminal page (T not page-
+        aligned) is privatized immediately — copy-on-write at map time:
+        the request's very first decode write lands past the shared
+        prefix INSIDE that page, so the copy (into the slot's own zeroed
+        native page, prompt rows only) happens before the write can
+        touch shared storage. The slot enters decode THIS iteration."""
+        now = self.clock.now()
+        entry.admit_time = now
+        req_span = self._req_spans.get(entry.request_id)
+        self.histograms.observe("serve.queue_wait_s", now - entry.submit_time)
+        TELEMETRY.event(
+            "serve.admit", request_id=entry.request_id, parent=req_span,
+            slot=idx, queue_wait_s=now - entry.submit_time,
+            clamped=entry.clamped,
+        )
+        nodes = hit.nodes
+        terminal = nodes[-1]
+        cow = terminal.valid < self.page
+        shared = nodes[:-1] if cow else list(nodes)
+        ids = jnp.asarray([n.page_id for n in shared], jnp.int32)
+        ring = terminal.ring
+        n_p = self.n_pages_slot
+        T = self.T
+
+        def fn(path, x):
+            key = getattr(path[-1], "key", None)
+            if key == "page_table":
+                return x.at[idx, : len(shared)].set(ids) if len(shared) else x
+            if key in ("cached_key_pages", "cached_value_pages"):
+                if cow:
+                    return paged_kv.copy_pages(
+                        x, [terminal.page_id],
+                        [idx * n_p + len(nodes) - 1], [terminal.valid],
+                    )
+                return x
+            if key in ("cache_index", "shift_index"):
+                return x.at[idx].set(T)
+            if key == "shift_hist":
+                return x.at[idx].set(ring[jax.tree_util.keystr(path)])
+            return x
+
+        self.cache = jax.tree_util.tree_map_with_path(fn, self.cache)
+        if cow:
+            self.prefix.release([terminal])
+            self.counters.inc("serve.prefix.cow_copies")
+        slot = _Slot(
+            entry, idx, first_token=-1, pos=T,
+            admit_seq=self._admit_seq, phase=_DECODE,
+        )
+        self._admit_seq += 1
+        slot.shared_nodes = shared
+        slot.snap_from = T
+        key = jax.random.fold_in(jax.random.key(entry.request.seed), T)
+        self.dispatches += 1
+        self.counters.inc("serve.dispatches")
+        tok = _sample_cached_jit(
+            terminal.logits, key, self.k_img, self.config.temperature
+        )
+        tok0 = int(tok[0])
+        entry.generated = [tok0]
+        slot.tok = tok0
+        self.slots[idx] = slot
+        self.counters.inc("serve.admitted")
+        self._note_prefix_outcome(entry, hit, req_span, idx, cow=cow)
+        # stamp AFTER the sample's host sync: every other path's first-
+        # token stamp includes its compute, so the cached-vs-cold TTFT
+        # comparison must charge the cached path its sample dispatch too
+        self._record_first_token(entry, self.clock.now())
+        if len(entry.generated) >= entry.effective_max_new:
+            self._complete(slot)
+
+    # ------------------------------------------------------- prefix cache
+
+    def _internal_tokens(self, entry: Entry) -> np.ndarray:
+        """The request's INTERNAL prompt row (bos + remap) as host ints —
+        the prefix chain key and the publish source of truth; computed
+        once per request (one tiny device roundtrip), cached on the
+        entry so preemption replays reuse it."""
+        if entry.internal_tokens is None:
+            text = jnp.asarray(entry.request.prompt, jnp.int32)[None, :]
+            entry.internal_tokens = np.asarray(self.dalle.remap_text(text))[0]
+        return entry.internal_tokens
+
+    def _probe_admission(self, entry: Entry) -> _AdmitHit:
+        """Probe the prefix index with the prompt's chain and filter to
+        the USABLE prefix: a full hit needs the terminal payload (ring +
+        logits); a partial hit needs the chunk machinery and a RESUMABLE
+        boundary strictly inside the prompt (split mode additionally
+        refuses a 1-token tail — it would chunk as a width-1 M=1 matvec,
+        the bit-parity hazard `_next_chunk` exists to avoid). References
+        on the returned nodes are ACQUIRED here."""
+        if self.prefix is None:
+            return _NO_HIT
+        toks = self._internal_tokens(entry)
+        col0 = self.prefix.stats.collisions
+        # count=False: a page-blocked head-of-line entry re-probes every
+        # scheduling iteration; _note_prefix_outcome tallies ONE hit or
+        # miss per admission so stats track the serve.prefix.* counters
+        nodes = self.prefix.probe(toks, self.clock.now(), count=False)
+        if self.prefix.stats.collisions > col0:
+            # a forged/colliding lookup was rejected by token
+            # verification (the prefix_hash_collide drill): the walk
+            # stopped at the collision — cold prefill from there
+            self.counters.inc("serve.fault_prefix_hash_collide")
+        full = (
+            bool(nodes)
+            and nodes[-1].coverage == self.T
+            and nodes[-1].logits is not None
+            and nodes[-1].ring is not None
+        )
+        if not full:
+            if self.config.prefill_chunk is None:
+                nodes = []
+            else:
+                while nodes and (
+                    not nodes[-1].resumable
+                    or nodes[-1].coverage >= self.T
+                    or (
+                        not self.fused
+                        and self.T - nodes[-1].coverage == 1
+                    )
+                ):
+                    nodes.pop()
+        if not nodes:
+            return _NO_HIT
+        shared = len(nodes) if (full or self.fused) else 0
+        if full and nodes[-1].valid < self.page:
+            shared -= 1  # the partial terminal page is COW'd, not shared
+        self.prefix.acquire(nodes, self.clock.now())
+        return _AdmitHit(nodes=nodes, full=full, shared=shared)
+
+    def _note_prefix_outcome(
+        self, entry: Entry, hit: _AdmitHit, req_span, idx: int,
+        cow: bool = False,
+    ) -> None:
+        """Hit/miss accounting for one admission (replays count again —
+        they re-probe). The TTFT hit-class label sticks to the admission
+        that will produce the first token."""
+        if self.prefix is None:
+            return
+        if hit.n_pages:
+            self._prefix_hits += 1
+            self.prefix.stats.hits += 1
+            self.counters.inc("serve.prefix.hits")
+            self.counters.inc("serve.prefix.pages_hit", hit.n_pages)
+            TELEMETRY.event(
+                "serve.prefix_hit", request_id=entry.request_id,
+                parent=req_span, slot=idx, pages=hit.n_pages,
+                kind=hit.kind, coverage=hit.coverage, cow=cow,
+            )
+        else:
+            self._prefix_misses += 1
+            self.prefix.stats.misses += 1
+            self.counters.inc("serve.prefix.misses")
+        if entry.ttft_s is None:
+            entry.hit_class = hit.kind
+
+    def _reclaim_index_pages(self, n: int) -> bool:
+        """The index's own eviction tier: drop LRU unreferenced leaf
+        nodes (refcounted pages are never victims) until ``n`` logical
+        pages are freed — tried BEFORE any running request is preempted
+        (an index page only costs future recompute; a preemption
+        discards real work). False when the index cannot help — checked
+        BEFORE evicting anything: a partial reclaim that still misses
+        the target would wipe the cached working set without admitting
+        a single request."""
+        if self.prefix is None or self.prefix.reclaimable_pages() < n:
+            return False
+        freed = 0
+        while freed < n:
+            if self.prefix.evict_one() is None:
+                break
+            self.pool.release(PREFIX_HOLDER, 1)
+            self.counters.inc("serve.prefix.evictions")
+            freed += 1
+        return freed >= n
+
+    def _maybe_snapshot(self, slot: _Slot, cache, row: int) -> None:
+        """Capture the shift-ring seam when a prefill lands exactly on a
+        page boundary (or the prompt end) beyond the already-indexed
+        prefix — the payload that makes the published node RESUMABLE.
+        Boundaries the chunk schedule never lands on are simply not
+        captured; their nodes publish content-only."""
+        if self.prefix is None:
+            return
+        s = slot.filled
+        if s <= slot.snap_from:
+            return
+        if s == self.T or s % self.page == 0:
+            slot.boundary_rings[s] = _ring_snapshot(cache, row)
+
+    def _publish(self, slot: _Slot) -> None:
+        """Publish a completing request's fully written prompt pages into
+        the prefix index (dedup-on-insert): pages already on the chain
+        are counted deduped (and upgraded with any seam/logits payloads
+        this run observed); new pages are copied into arena pages — one
+        batched device copy — and committed with their boundary rings.
+        Fail-open by contract: arena/budget exhaustion or the
+        ``prefix_publish_fail`` fault skip publication and the request
+        still completes with its pages private."""
+        entry = slot.entry
+        if FAULTS.take("prefix_publish_fail"):
+            self.counters.inc("serve.fault_prefix_publish_fail")
+            self.prefix.stats.publish_skips += 1
+            self.counters.inc("serve.prefix.publish_skips")
+            return
+        toks = self._internal_tokens(entry)
+        blocks = chain_blocks(toks, self.page)
+        now = self.clock.now()
+        existing = self.prefix.match(toks)
+        dedup = max(0, len(existing) - len(slot.shared_nodes))
+        if dedup:
+            self.prefix.stats.deduped += dedup
+            self.counters.inc("serve.prefix.pages_deduped", dedup)
+        for node in existing:
+            self.prefix.upgrade(
+                node,
+                ring=slot.boundary_rings.get(node.coverage),
+                logits=(
+                    slot.final_logits if node.coverage == self.T else None
+                ),
+            )
+        if len(existing) == len(blocks):
+            return
+        # pin the chain (and each new node) against the LRU reclaim the
+        # allocation below may trigger — a reclaimed parent would orphan
+        # its children
+        protected = list(existing)
+        self.prefix.acquire(protected, now)
+        src, dst, valids = [], [], []
+        try:
+            parent = existing[-1] if existing else None
+            n_p = self.n_pages_slot
+            for k in range(len(existing), len(blocks)):
+                block = blocks[k]
+                cov = k * self.page + len(block)
+                ring = slot.boundary_rings.get(cov)
+                logits = slot.final_logits if cov == self.T else None
+                if cov == self.T and ring is None and logits is None:
+                    # a terminal node with neither seam nor logits can
+                    # serve no hit (full needs logits, partial trims
+                    # coverage >= T) — e.g. a full-hit slot republishing
+                    # its COW page after the original terminal was
+                    # evicted mid-decode. Don't spend an arena page on
+                    # it; the next cold run publishes the payloads.
+                    break
+                page_id = self.prefix.alloc_page()
+                if page_id is None and self._reclaim_index_pages(1):
+                    page_id = self.prefix.alloc_page()
+                if page_id is None:
+                    self.prefix.stats.publish_skips += 1
+                    self.counters.inc("serve.prefix.publish_skips")
+                    break
+                if not self.pool.alloc(PREFIX_HOLDER, 1):
+                    if not (
+                        self._reclaim_index_pages(1)
+                        and self.pool.alloc(PREFIX_HOLDER, 1)
+                    ):
+                        self.prefix.return_page(page_id)
+                        self.prefix.stats.publish_skips += 1
+                        self.counters.inc("serve.prefix.publish_skips")
+                        break
+                node = self.prefix.insert(
+                    parent, block, start=k * self.page, page_id=page_id,
+                    now=now, ring=ring, logits=logits,
+                )
+                self.prefix.acquire([node], now)
+                protected.append(node)
+                parent = node
+                src.append(slot.index * n_p + k)
+                dst.append(page_id)
+                valids.append(len(block))
+        finally:
+            self.prefix.release(protected)
+        if not dst:
+            return
+
+        def fn(path, x):
+            if getattr(path[-1], "key", None) in (
+                "cached_key_pages", "cached_value_pages"
+            ):
+                return paged_kv.copy_pages(x, src, dst, valids)
+            return x
+
+        self.cache = jax.tree_util.tree_map_with_path(fn, self.cache)
+        self.counters.inc("serve.prefix.published", len(dst))
 
     def _degraded_budget(self, entry: Entry) -> tuple:
         return self._clamped_budget(entry.request.max_new_tokens)
@@ -780,7 +1311,18 @@ class Engine:
         if len(self.sched):
             return False
         eff_max_new, _ = self._clamped_budget(request.max_new_tokens)
-        return self._worst_case_pages(eff_max_new) <= self.pool.free
+        avail = self.pool.free
+        if self.prefix is not None:
+            # the index is its own last-resort eviction tier: _admit
+            # reclaims unreferenced index pages before refusing, so they
+            # are available to a dispatch decision even though the pool
+            # charges them to __prefix__ — without this a tightly
+            # budgeted prefix replica would gate itself shut forever.
+            # (A prefix HIT can only shrink the real demand further;
+            # probing here would cost a device roundtrip per poll, so
+            # the gate stays conservative on that side.)
+            avail += self.prefix.reclaimable_pages()
+        return self._worst_case_pages(eff_max_new) <= avail
 
     def _fresh_prefill_cache(self):
         """A donate-safe copy of the pristine batch-1 cache template: the
@@ -807,11 +1349,11 @@ class Engine:
         )
         self.dispatches += 1
         self.counters.inc("serve.dispatches")
-        cache1, tok = _prefill_jit(
+        cache1, tok, img = _prefill_jit(
             self.dalle, self.params, self._fresh_prefill_cache(), internal,
             key, self.k_img, self.config.temperature,
         )
-        return cache1, int(tok[0])
+        return cache1, int(tok[0]), img
 
     # ----------------------------------------------------- chunked prefill
 
@@ -899,11 +1441,13 @@ class Engine:
                         key = jax.random.fold_in(
                             jax.random.key(entry.request.seed), self.T
                         )
-                        slot.cache1, tok = _prefill_last_jit(
+                        slot.cache1, tok, img = _prefill_last_jit(
                             self.dalle, self.params, slot.cache1, chunk,
                             jnp.int32(slot.filled), self.k_img, key,
                             self.config.temperature,
                         )
+                        if self.prefix is not None:
+                            slot.final_logits = img
                         tok0 = int(tok[0])
                     else:
                         slot.cache1 = _prefill_chunk_jit(
@@ -923,6 +1467,9 @@ class Engine:
                         jax.block_until_ready(slot.cache1)
                 slot.filled += c
                 grant -= c
+                # page-boundary ring seams for the publish payload —
+                # captured from the private cache while it exists
+                self._maybe_snapshot(slot, slot.cache1, 0)
                 if final:
                     self._finish_prefill(slot, tok0)
                     break
@@ -1005,7 +1552,9 @@ class Engine:
         ):
             if self.slots[slot.index] is not slot:
                 continue
-            needed = slot.pos // self.page + 1
+            # pages covering [0, pos], minus the prefix pages the slot
+            # maps SHARED (charged to the index, not to this request)
+            needed = slot.pos // self.page + 1 - len(slot.shared_nodes)
             deficit = needed - self.pool.held(slot.entry.request_id)
             if deficit > 0 and not self._alloc_or_preempt(slot, deficit):
                 continue
@@ -1117,7 +1666,7 @@ class Engine:
             keys = keys.at[jnp.asarray(key_idx)].set(jnp.stack(key_list))
         self.dispatches += 1
         self.counters.inc("serve.dispatches")
-        self.cache, samples = _iteration_jit(
+        self.cache, samples, flogits = _iteration_jit(
             self.dalle, self.params, self.cache, self._prompts,
             tok, jnp.asarray(start), jnp.asarray(length), jnp.asarray(final),
             keys, self._W, self.k_img, self.config.temperature,
@@ -1131,7 +1680,12 @@ class Engine:
             s.tok_on_device = True
         for s, c in chunks:
             s.filled += c
+            # the row's chunks live in the batched cache — page-boundary
+            # ring seams for publish are sliced from it post-dispatch
+            self._maybe_snapshot(s, self.cache, s.index)
             if final[s.index]:
+                if self.prefix is not None and flogits is not None:
+                    s.final_logits = flogits[s.index][None]
                 # prefill complete at DISPATCH: the row's cache is fully
                 # written and its first image token is in the in-flight
                 # samples, so the slot transitions to the decode phase
@@ -1187,6 +1741,17 @@ class Engine:
             return
         entry.ttft_s = now - entry.submit_time
         self.histograms.observe("serve.ttft_s", entry.ttft_s)
+        if self.prefix is not None:
+            # TTFT split by hit class: what the zipf bench's cached-vs-
+            # cold comparison reads (docs/DESIGN.md §9)
+            if entry.hit_class == "full":
+                self.histograms.observe("serve.ttft_full_hit_s", entry.ttft_s)
+            elif entry.hit_class == "partial":
+                self.histograms.observe(
+                    "serve.ttft_partial_hit_s", entry.ttft_s
+                )
+            else:
+                self.histograms.observe("serve.ttft_cold_s", entry.ttft_s)
         TELEMETRY.event(
             "serve.first_token", request_id=entry.request_id,
             parent=self._req_spans.get(entry.request_id),
@@ -1224,7 +1789,9 @@ class Engine:
         ):
             if self.slots[slot.index] is not slot:
                 continue  # evicted by a previous iteration of this loop
-            needed = slot.pos // self.page + 1
+            # pages covering [0, pos], minus the prefix pages the slot
+            # maps SHARED (charged to the index, not to this request)
+            needed = slot.pos // self.page + 1 - len(slot.shared_nodes)
             deficit = needed - self.pool.held(slot.entry.request_id)
             if deficit > 0 and not self._alloc_or_preempt(slot, deficit):
                 continue  # the requester itself was evicted
@@ -1318,13 +1885,17 @@ class Engine:
 
     def _alloc_or_preempt(self, slot: _Slot, n: int) -> bool:
         """Allocate ``n`` pages for ``slot``, evicting victims until it
-        fits. Returns False when the requester itself was the victim."""
+        fits — unreferenced prefix-index pages first (LRU; refcounted
+        pages are never victims), then running requests. Returns False
+        when the requester itself was the victim."""
         while True:
             blocked = FAULTS.take("page_exhaust")
             if blocked:
                 self.counters.inc("serve.fault_page_exhaust")
             if not blocked and self.pool.alloc(slot.entry.request_id, n):
                 return True
+            if not blocked and self._reclaim_index_pages(1):
+                continue
             victim = self._pick_victim()
             assert victim is not None, "requester is running, so a victim exists"
             self._preempt(victim)
@@ -1382,9 +1953,22 @@ class Engine:
         slot never wrote its batched row (its chunks live in a private
         batch-1 cache, dropped here) so it skips the device reset; a
         FUSED-mode prefilling slot wrote its chunks in place and resets
-        like a decoding slot."""
+        like a decoding slot.
+
+        Prefix-cache discipline: shared mappings are RELEASED (refcount
+        only — the pages live in arena rows the reset below cannot name;
+        ``paged_kv.reset_rows``), and the row bound is asserted so an
+        arena row can never be zeroed through this path."""
+        if slot.shared_nodes:
+            self.prefix.release(slot.shared_nodes)
+            slot.shared_nodes = []
         self.pool.free_all(slot.entry.request_id)
         idx = slot.index
+        assert 0 <= idx < self.config.max_batch, (
+            f"slot reset named row {idx} outside the slot rows "
+            f"[0, {self.config.max_batch}) — arena rows are owned by the "
+            "prefix index and are never reset here"
+        )
         if slot.phase == _PREFILL:
             TELEMETRY.end(
                 slot.prefill_span, outcome="aborted", filled=slot.filled
@@ -1413,6 +1997,10 @@ class Engine:
         self.slots[slot.index] = None
 
     def _complete(self, slot: _Slot) -> None:
+        if self.prefix is not None:
+            # publish BEFORE release: the copies read the slot's native
+            # pages, which the release reset zeroes
+            self._publish(slot)
         self._release_slot(slot)
         self.counters.inc("serve.completed")
         self._finish(
@@ -1491,11 +2079,16 @@ class Engine:
         Always checked (valid mid-flight):
           * every submitted request is live XOR has exactly one result;
           * live requests are exactly the queued + running sets;
-          * every page holder is a running request;
-          * outcome counts sum to the result count.
+          * every page holder is a running request (or the prefix index);
+          * outcome counts sum to the result count;
+          * prefix refcount accounting: the index's budget charge equals
+            its page count, arena pages neither leak nor alias, and the
+            sum of node refcounts equals the shared table mappings the
+            live slots hold.
         With ``idle=True`` (after ``run()``): additionally nothing queued
-        or running, no live in-flight decode step, and the pool fully
-        drained.
+        or running, no live in-flight decode step, and the pool drained
+        down to exactly the index's pages (the cache SURVIVES drain —
+        cross-request reuse is its purpose; no request page leaks).
 
         Cost: O(live requests + slots), independent of how many results a
         long-lived engine has accumulated (outcome tallies are
@@ -1513,10 +2106,24 @@ class Engine:
             f"live set {sorted(self._live)} != queued {sorted(queued_ids)} "
             f"| running {sorted(running_ids)}"
         )
-        assert self.pool.holders() <= running_ids, (
+        assert self.pool.holders() - {PREFIX_HOLDER} <= running_ids, (
             "page leak: pages held by non-running requests "
-            f"{sorted(self.pool.holders() - running_ids)}"
+            f"{sorted(self.pool.holders() - {PREFIX_HOLDER} - running_ids)}"
         )
+        index_pages = 0
+        if self.prefix is not None:
+            index_pages = len(self.prefix)
+            assert self.pool.held(PREFIX_HOLDER) == index_pages, (
+                f"prefix budget drift: index holds {index_pages} pages "
+                f"but is charged {self.pool.held(PREFIX_HOLDER)}"
+            )
+            self.prefix.verify_invariants()
+            mapped = sum(len(s.shared_nodes) for s in self.slots if s)
+            refs = self.prefix.total_refs()
+            assert refs == mapped, (
+                f"prefix refcount drift: {refs} references held but "
+                f"{mapped} shared table mappings live"
+            )
         outcomes = self.stats()["outcomes"]
         assert sum(outcomes.values()) == len(self.results), outcomes
         if not idle:
@@ -1530,8 +2137,9 @@ class Engine:
         assert not any(
             self.slots[s.index] is s for s in pending_slots
         ), "engine idle with a live in-flight decode step"
-        assert self.pool.used == 0, (
-            f"page leak: {self.pool.used} pages still held"
+        assert self.pool.used == index_pages, (
+            f"page leak: {self.pool.used} pages still held with only "
+            f"{index_pages} owned by the prefix index"
         )
 
     def _publish_gauges(self) -> None:
@@ -1545,6 +2153,13 @@ class Engine:
             sum(bool(s) and s.phase == _PREFILL for s in self.slots),
         )
         self.gauges.set("serve.queued", len(self.sched))
+        if self.prefix is not None:
+            probes = self._prefix_hits + self._prefix_misses
+            self.gauges.set(
+                "serve.prefix_hit_frac",
+                self._prefix_hits / probes if probes else 0.0,
+            )
+            self.gauges.set("serve.prefix_pages", float(len(self.prefix)))
 
 
 class _PrefillFault(RuntimeError):

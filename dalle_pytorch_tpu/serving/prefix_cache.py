@@ -1,0 +1,357 @@
+"""Content-addressed index over immutable, fully written KV pages —
+cross-request prefix caching (ROADMAP 3; FastUSP's shared-resource
+framing, PAPERS.md 2602.10940).
+
+Production text-to-image traffic is heavily templated: shared system /
+style prompt prefixes and retried prompts re-run identical prefill work
+on every request. The block-paged layout (PR 1) and the global-id page
+tables (ops/paged_kv.py) make deduplicating that work a PAGE-TABLE
+INDIRECTION: this module indexes already-computed prompt KV pages by the
+HASH CHAIN of the token ids they cover, and the engine maps hit pages
+into an admitted slot's table read-only instead of recomputing them.
+
+The index is pure HOST bookkeeping — no jax import, no device arrays of
+its own. Physical page content lives in dedicated ARENA rows of the
+engine's batched cache pools (rows past the slot rows, reachable only
+through remapped table entries); this module owns the arena ID space and
+the chain index, while the engine performs every device copy
+(``paged_kv.copy_pages``) and table write. The ring-seam and
+terminal-logits payloads are stored as opaque objects (device arrays in
+practice) — captured by the engine at prefill page boundaries, restored
+by the engine at resume.
+
+Chain addressing: the prompt's internal token row is cut into page-sized
+blocks plus one terminal partial block ending at T; node ``k``'s digest
+is ``sha1(parent_digest || block_bytes)``, so two prompts share exactly
+the nodes of their common page-aligned prefix. Every lookup VERIFIES the
+stored token block against the query before a page is mapped — the hash
+is an address, never a proof — and the ``prefix_hash_collide`` fault
+site forces a forged lookup result so tests can pin that a collision
+falls back to cold prefill instead of serving another prompt's K/V.
+
+Refcount invariants (asserted by ``Engine.verify_invariants``):
+
+* ``node.refcount`` == number of live slots currently mapping the node's
+  page; acquire/release are engine-driven and symmetric across every
+  termination path (complete / preempt / deadline / cancel).
+* a node with ``refcount > 0`` is NEVER an eviction victim — shared
+  pages are not reclaimable while any sequence can still gather them;
+* eviction is leaf-first (``children == 0``; an interior node's eviction
+  would orphan reachable descendants) and LRU by ``last_hit`` — the
+  index is its own eviction tier: unreferenced cache pages are dropped
+  to free budget BEFORE any running request is preempted (a preemption
+  discards real work; an index page only costs future recompute).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.faults import FAULTS
+
+_ROOT = b"prefix-cache-root"
+
+
+def chain_blocks(tokens: np.ndarray, page_size: int) -> List[np.ndarray]:
+    """Cut a prompt's internal token row into its chain blocks: full
+    ``page_size`` blocks plus one terminal partial block ending at T
+    (absent only when T divides evenly — then the last full block IS the
+    terminal). Block k covers positions [k * page_size, ...)."""
+    t = np.asarray(tokens, np.int64).reshape(-1)
+    return [t[i: i + page_size] for i in range(0, len(t), page_size)]
+
+
+def _digest(parent: bytes, block: np.ndarray) -> bytes:
+    return hashlib.sha1(
+        parent + np.asarray(block, np.int64).tobytes()
+    ).digest()
+
+
+@dataclass
+class PageNode:
+    """One immutable, fully written KV page, content-addressed by the
+    hash chain of the token ids it covers. ``page_id`` is the GLOBAL
+    physical page (an arena page of the engine's pools); ``valid`` the
+    written row count (== page_size except the terminal block); ``ring``
+    the opaque shift-ring seam at position ``coverage`` (present iff the
+    publisher observed that boundary — the resume requirement); and
+    ``logits`` the terminal image-head logits (full-prefix nodes only —
+    what lets a full hit sample its first token without any prefill)."""
+
+    digest: bytes
+    parent: Optional[bytes]
+    tokens: np.ndarray
+    start: int
+    page_id: int
+    ring: Any = None
+    logits: Any = None
+    refcount: int = 0
+    last_hit: float = 0.0
+    children: int = 0
+
+    @property
+    def coverage(self) -> int:
+        return self.start + len(self.tokens)
+
+    @property
+    def valid(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def resumable(self) -> bool:
+        """A node the engine can RESUME prefill from (or, with logits,
+        enter decode from): it carries the shift-ring seam at its
+        coverage boundary."""
+        return self.ring is not None
+
+
+@dataclass
+class PrefixStats:
+    hits: int = 0
+    misses: int = 0
+    collisions: int = 0
+    published: int = 0
+    deduped: int = 0
+    evicted: int = 0
+    publish_skips: int = 0
+
+
+class PrefixCache:
+    """See module docstring. Single-threaded like the engine that owns
+    it (the engine's scheduling loop is the only caller)."""
+
+    def __init__(self, arena_page_ids: Sequence[int], page_size: int):
+        assert page_size > 0, page_size
+        self.page_size = page_size
+        self.arena_total = len(arena_page_ids)
+        self._free_pages: List[int] = list(arena_page_ids)
+        self._nodes: Dict[bytes, PageNode] = {}
+        self.stats = PrefixStats()
+
+    # ------------------------------------------------------------- sizing
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def free_arena_pages(self) -> int:
+        return len(self._free_pages)
+
+    def nodes(self) -> List[PageNode]:
+        """Every indexed node (invariant checks / tests)."""
+        return list(self._nodes.values())
+
+    def total_refs(self) -> int:
+        return sum(n.refcount for n in self._nodes.values())
+
+    # -------------------------------------------------------------- probe
+    def _lookup_child(self, parent: bytes, block: np.ndarray) -> Optional[PageNode]:
+        """Address the child by chain digest, then VERIFY the stored
+        token block — the hash is an address, never a proof. The
+        ``prefix_hash_collide`` fault forges the lookup result (returns a
+        node whose stored block does not match the query) so the
+        verification path is drillable on CPU."""
+        digest = _digest(parent, block)
+        node = self._nodes.get(digest)
+        # index-emptiness guard FIRST: take() consumes the armed count on
+        # every call, and an env-armed drill must spend its budget on a
+        # probe that can actually forge a node (the cold round's probes
+        # run against an empty index)
+        if self._nodes and FAULTS.take("prefix_hash_collide"):
+            node = next(iter(self._nodes.values()))
+            if np.array_equal(
+                np.asarray(node.tokens, np.int64), np.asarray(block, np.int64)
+            ):
+                node = PageNode(
+                    digest=digest, parent=parent,
+                    tokens=np.asarray(block, np.int64) + 1,
+                    start=node.start, page_id=node.page_id,
+                )
+        if node is None:
+            return None
+        if not np.array_equal(
+            np.asarray(node.tokens, np.int64), np.asarray(block, np.int64)
+        ):
+            self.stats.collisions += 1
+            return None
+        return node
+
+    def probe(
+        self, tokens: np.ndarray, now: float, count: bool = True
+    ) -> List[PageNode]:
+        """Walk the prompt's chain and return the VERIFIED matched prefix
+        nodes (possibly empty). Touches ``last_hit`` on every matched
+        node; does NOT take references — the engine acquires exactly the
+        nodes it maps. ``count=False`` skips the hit/miss tally: the
+        engine re-probes a page-blocked head-of-line request every
+        scheduling iteration and counts ONE hit or miss per admission
+        (in ``_note_prefix_outcome``), so its stats stay in lockstep
+        with the ``serve.prefix.*`` counters."""
+        out: List[PageNode] = []
+        parent = _ROOT
+        for block in chain_blocks(tokens, self.page_size):
+            node = self._lookup_child(parent, block)
+            if node is None:
+                break
+            node.last_hit = now
+            out.append(node)
+            parent = node.digest
+        if count:
+            if out:
+                self.stats.hits += 1
+            else:
+                self.stats.misses += 1
+        return out
+
+    def match(self, tokens: np.ndarray) -> List[PageNode]:
+        """The probe walk WITHOUT hit/miss accounting, recency touches,
+        or fault injection — the publish path's dedup check (a publisher
+        consulting the chain is not a cache consumer)."""
+        out: List[PageNode] = []
+        parent = _ROOT
+        for block in chain_blocks(tokens, self.page_size):
+            node = self._nodes.get(_digest(parent, block))
+            if node is None or not np.array_equal(
+                np.asarray(node.tokens, np.int64), np.asarray(block, np.int64)
+            ):
+                break
+            out.append(node)
+            parent = node.digest
+        return out
+
+    # ---------------------------------------------------------- refcounts
+    def acquire(self, nodes: Sequence[PageNode], now: float) -> None:
+        for n in nodes:
+            assert n.digest in self._nodes, "acquire of evicted node"
+            n.refcount += 1
+            n.last_hit = now
+
+    def release(self, nodes: Sequence[PageNode]) -> None:
+        for n in nodes:
+            assert n.refcount > 0, (
+                f"refcount underflow for node at {n.start}"
+            )
+            n.refcount -= 1
+
+    # ------------------------------------------------------------ publish
+    def alloc_page(self) -> Optional[int]:
+        """Pop a free arena page id; None when the arena is exhausted
+        (the engine then evicts LRU unreferenced nodes or fails open)."""
+        return self._free_pages.pop() if self._free_pages else None
+
+    def return_page(self, page_id: int) -> None:
+        """Hand back a page allocated but never committed (a publish that
+        failed between alloc and insert)."""
+        self._free_pages.append(page_id)
+
+    def insert(
+        self,
+        parent: Optional[PageNode],
+        block: np.ndarray,
+        start: int,
+        page_id: int,
+        now: float,
+        ring: Any = None,
+        logits: Any = None,
+    ) -> PageNode:
+        """Commit one published page (dedup is the CALLER's probe-first
+        protocol: inserting an existing chain position is a bug)."""
+        parent_digest = _ROOT if parent is None else parent.digest
+        digest = _digest(parent_digest, block)
+        assert digest not in self._nodes, "dedup-on-insert violated"
+        node = PageNode(
+            digest=digest,
+            parent=None if parent is None else parent.digest,
+            tokens=np.asarray(block, np.int64).copy(),
+            start=start,
+            page_id=page_id,
+            ring=ring,
+            logits=logits,
+            last_hit=now,
+        )
+        self._nodes[digest] = node
+        if parent is not None:
+            parent.children += 1
+        self.stats.published += 1
+        return node
+
+    def upgrade(self, node: PageNode, ring: Any = None, logits: Any = None) -> None:
+        """Fill state an earlier publisher did not observe (a chunk
+        schedule that skipped the boundary): the page content is already
+        bit-identical by content addressing, so only the missing seam /
+        logits payloads are added — never replaced."""
+        if ring is not None and node.ring is None:
+            node.ring = ring
+        if logits is not None and node.logits is None:
+            node.logits = logits
+
+    def reclaimable_pages(self) -> int:
+        """How many pages the leaf-first LRU eviction loop could free
+        RIGHT NOW: the nodes of fully unreferenced subtrees (a refcount
+        anywhere pins its whole ancestor chain — evicting an ancestor
+        would orphan the referenced descendant). ``Engine.can_admit``
+        counts these as available budget, mirroring what
+        ``_reclaim_index_pages`` would actually evict."""
+        pinned: set = set()
+        for n in self._nodes.values():
+            if n.refcount > 0:
+                d: Optional[bytes] = n.digest
+                while d is not None and d not in pinned:
+                    pinned.add(d)
+                    node = self._nodes.get(d)
+                    d = node.parent if node is not None else None
+        return len(self._nodes) - len(pinned)
+
+    # ------------------------------------------------------------- evict
+    def evictable(self) -> List[PageNode]:
+        """Eviction candidates: refcount == 0 (shared pages are not
+        victims) AND children == 0 (leaf-first — an interior eviction
+        would orphan reachable descendants), LRU-first."""
+        return sorted(
+            (
+                n for n in self._nodes.values()
+                if n.refcount == 0 and n.children == 0
+            ),
+            key=lambda n: n.last_hit,
+        )
+
+    def evict_one(self) -> Optional[PageNode]:
+        """Drop the LRU unreferenced leaf, returning its node (the engine
+        discharges the page budget); None when nothing is evictable."""
+        cands = self.evictable()
+        if not cands:
+            return None
+        node = cands[0]
+        del self._nodes[node.digest]
+        if node.parent is not None and node.parent in self._nodes:
+            self._nodes[node.parent].children -= 1
+        self._free_pages.append(node.page_id)
+        self.stats.evicted += 1
+        return node
+
+    # -------------------------------------------------------- invariants
+    def verify_invariants(self) -> None:
+        """Structural self-checks, composed into the engine's
+        ``verify_invariants``: arena accounting (every node owns a
+        distinct arena page; free + held == total), chain integrity
+        (every non-root parent present — leaf-first eviction can never
+        orphan), and child counts."""
+        held = [n.page_id for n in self._nodes.values()]
+        assert len(held) == len(set(held)), "node pages alias"
+        assert len(held) + len(self._free_pages) == self.arena_total, (
+            f"arena leak: {len(held)} held + {len(self._free_pages)} free "
+            f"!= {self.arena_total}"
+        )
+        kids: Dict[bytes, int] = {}
+        for n in self._nodes.values():
+            assert n.refcount >= 0, "negative refcount"
+            if n.parent is not None:
+                assert n.parent in self._nodes, "orphaned chain node"
+                kids[n.parent] = kids.get(n.parent, 0) + 1
+        for n in self._nodes.values():
+            assert n.children == kids.get(n.digest, 0), (
+                "child count drift"
+            )

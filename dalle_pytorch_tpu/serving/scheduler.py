@@ -155,6 +155,18 @@ class PagePool:
         self._held[request_id] = self._held.get(request_id, 0) + n
         return True
 
+    def release(self, request_id: str, n: int) -> None:
+        """Return ``n`` of a holder's pages without zeroing its whole
+        account — the prefix index's eviction tier shrinks page by page
+        (holder ``Engine.PREFIX_HOLDER``), unlike request holders whose
+        every terminal path converges on ``free_all``."""
+        held = self._held.get(request_id, 0)
+        assert 0 <= n <= held, (request_id, n, held)
+        if held == n:
+            self._held.pop(request_id, None)
+        else:
+            self._held[request_id] = held - n
+
     def free_all(self, request_id: str) -> int:
         return self._held.pop(request_id, 0)
 
@@ -183,6 +195,13 @@ class Entry:
     # whether this queue residency counts against the client-facing bound
     # (True for fresh submissions, False for preemption/retry requeues)
     counted: bool = True
+    # the request's INTERNAL prompt token row (host ints; bos + remap),
+    # computed once at first admission — the prefix cache's chain key
+    # and the publish-side source of truth
+    internal_tokens: Optional[object] = None
+    # prefix-cache hit class of the admission that produced the first
+    # token ("full" | "partial"; None = cold) — the TTFT split label
+    hit_class: Optional[str] = None
 
     @property
     def request_id(self) -> str:

@@ -51,6 +51,15 @@ Sites in use:
                  the circuit breaker on a healthy replica (flapping
                  probe) — pins that breaker backoff prevents admission
                  livelock under repeated flaps
+``prefix_hash_collide`` ``serving.prefix_cache``: a probe lookup returns
+                 a FORGED chain node (a hash collision) — the mandatory
+                 token-id verification must reject it and the engine
+                 fall back to cold prefill, never serving another
+                 prompt's K/V
+``prefix_publish_fail`` ``serving.engine``: publishing a completed
+                 request's prompt pages into the prefix index fails —
+                 fail-open by contract: the request still completes
+                 normally and its pages stay private (freed, unindexed)
 ===============  =============================================================
 
 Injection must be impossible to leave on by accident: the registry is
@@ -78,6 +87,7 @@ KNOWN_SITES = frozenset({
     "page_exhaust", "prefill_fail", "decode_stall", "request_cancel",
     "telemetry_sink_fail",
     "replica_crash", "replica_stall", "health_flap",
+    "prefix_hash_collide", "prefix_publish_fail",
 })
 
 

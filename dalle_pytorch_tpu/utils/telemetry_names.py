@@ -56,6 +56,7 @@ EVENTS = frozenset({
     "serve.evict",
     "serve.decode_stall",
     "serve.prefill_retry",
+    "serve.prefix_hit",      # admission mapped >=1 cached prompt page
     # replicated front door
     "router.shed",
     "router.drain",
@@ -103,6 +104,17 @@ COUNTERS = frozenset({
     "serve.fault_prefill_fail",
     "serve.fault_decode_stall",
     "serve.fault_page_exhaust",
+    "serve.fault_prefix_hash_collide",
+    "serve.fault_prefix_publish_fail",
+    # cross-request prefix cache (serving/prefix_cache.py)
+    "serve.prefix.hits",          # probes matching >=1 page
+    "serve.prefix.misses",        # probes matching nothing
+    "serve.prefix.pages_hit",     # cached pages mapped/copied at admission
+    "serve.prefix.pages_deduped", # publish-side pages already indexed
+    "serve.prefix.cow_copies",    # shared terminal pages privatized
+    "serve.prefix.published",     # pages newly committed to the index
+    "serve.prefix.evictions",     # LRU index evictions (budget/arena)
+    "serve.prefix.publish_skips", # fail-open publishes (arena/budget full)
     # replicated front door
     "router.submitted",
     "router.shed",
@@ -146,6 +158,8 @@ GAUGES = frozenset({
     "serve.running",
     "serve.prefilling",
     "serve.queued",
+    "serve.prefix_hit_frac",     # hits / (hits + misses), lifetime
+    "serve.prefix_pages",        # pages currently held by the index
     "router.queued",
     "router.fleet_occupancy",
     "router.replicas_live",
@@ -160,6 +174,11 @@ HISTOGRAMS = frozenset({
     "serve.request_latency_s",
     "serve.completed_latency_s",
     "router.failover_latency_s",
+    # TTFT split by prefix-cache hit class (serve.ttft_s still carries
+    # every request; bench's cached-vs-cold comparison reads these)
+    "serve.ttft_full_hit_s",
+    "serve.ttft_partial_hit_s",
+    "serve.ttft_cold_s",
 })
 
 # span durations are auto-observed as "<span>_s" (utils/telemetry.py);

@@ -103,7 +103,8 @@ class TestPageOps:
 
     def test_reset_rows_and_tables(self):
         """Eviction reset (serving engine): the victim's rows go back to
-        pristine — zero pages, identity table — with other rows untouched."""
+        pristine — zero pages, identity table (GLOBAL ids r * n_pages + i)
+        — with other rows untouched."""
         rng = np.random.RandomState(2)
         pool = jnp.asarray(rng.rand(3, 2, 4, 2), jnp.float32)
         table = jnp.asarray([[1, 0], [0, 1], [1, 0]], jnp.int32)
@@ -112,8 +113,49 @@ class TestPageOps:
         np.testing.assert_array_equal(np.asarray(pool2)[[0, 2]], np.asarray(pool)[[0, 2]])
         table2 = paged_kv.reset_table_rows(table, [0, 2])
         np.testing.assert_array_equal(
-            np.asarray(table2), [[0, 1], [0, 1], [0, 1]]
+            np.asarray(table2), [[0, 1], [0, 1], [4, 5]]
         )
+
+    def test_identity_table_is_global(self):
+        """identity_table row r maps logical page i to GLOBAL physical
+        page r * n_pages + i — the flattened-view id space that lets a
+        table entry reference another row's storage (prefix sharing)."""
+        t = np.asarray(paged_kv.identity_table(3, 2))
+        np.testing.assert_array_equal(t, [[0, 1], [2, 3], [4, 5]])
+
+    def test_cross_row_gather_and_append(self):
+        """A table entry naming another row's physical page reads (and
+        writes through to) that row's storage — the prefix-sharing seam."""
+        b, f, page = 2, 2, 4
+        pool = paged_kv.alloc(b, 8, f, page)  # (2, 2, 4, 2); global ids 0..3
+        table = paged_kv.identity_table(b, 2)
+        rows = jnp.full((b, 1, f), 7.0)
+        pool = paged_kv.append(
+            pool, table, jnp.asarray([0, 0], jnp.int32), rows
+        )
+        # remap row 1's logical page 0 onto row 0's physical page 0
+        shared = table.at[1, 0].set(0)
+        flat = np.asarray(paged_kv.gather(pool, shared))
+        np.testing.assert_array_equal(flat[1, 0], flat[0, 0])
+        # a write through the shared entry lands in row 0's storage
+        pool2 = paged_kv.append(
+            pool, shared, jnp.asarray([8, 1], jnp.int32),  # row 1 pos 1
+            jnp.full((b, 1, f), 3.0),
+        )
+        assert np.asarray(pool2)[0, 0, 1].sum() == f * 3.0
+
+    def test_copy_pages_zeroes_past_valid(self):
+        """copy_pages moves whole physical pages and zeroes destination
+        rows past the per-page valid count — the publish / copy-on-write
+        primitive (a published terminal page must not leak image K/V)."""
+        rng = np.random.RandomState(3)
+        pool = jnp.asarray(rng.rand(2, 2, 4, 2), jnp.float32)
+        out = np.asarray(paged_kv.copy_pages(pool, src=[1], dst=[3], valid=[2]))
+        src = np.asarray(pool).reshape(4, 4, 2)[1]
+        np.testing.assert_array_equal(out[1, 1, :2], src[:2])
+        assert out[1, 1, 2:].sum() == 0
+        # other pages untouched
+        np.testing.assert_array_equal(out[0], np.asarray(pool)[0])
 
     def test_gather_variants_match(self):
         rng = np.random.RandomState(1)

@@ -311,7 +311,8 @@ class TestKernelParity:
         bidx = np.arange(b)[:, None]
         k_perm = jnp.asarray(np.asarray(k_pool)[bidx, perm])
         v_perm = jnp.asarray(np.asarray(v_pool)[bidx, perm])
-        table = jnp.asarray(inv)
+        # tables hold GLOBAL ids: row r's physical page p is r * n_p + p
+        table = jnp.asarray(inv + bidx * n_p)
         out = ra.kernel_attend(
             q, k_perm, v_perm, table, start, length, interpret=True
         )
@@ -402,8 +403,13 @@ class TestFusedStepParity:
             jax.tree_util.tree_leaves_with_path(row1_split),
             jax.tree_util.tree_leaves_with_path(pristine),
         ):
+            row1 = l1[0]
+            if getattr(p[-1], "key", None) == "page_table":
+                # tables hold GLOBAL ids: the batch-1 cache's row-0 pages
+                # sit one row offset below their batch-3 row-1 location
+                row1 = row1 + l1.shape[1]
             assert bool(jnp.all(ls[0] == lf[0])), f"decode row diverged: {p}"
-            assert bool(jnp.all(l1[0] == lf[1])), f"prefill row diverged: {p}"
+            assert bool(jnp.all(row1 == lf[1])), f"prefill row diverged: {p}"
             assert bool(jnp.all(lp[2] == lf[2])), f"idle row touched: {p}"
 
 
@@ -550,5 +556,15 @@ class TestTraceContract:
             "steady", "final"
         ]
         assert entry["donate"] == ["cache"]
-        assert entry["max_host_visible_outputs"] <= 1
+        # steady iterations read back the samples ONLY; final-chunk
+        # iterations additionally surface the per-row terminal logits —
+        # the prefix cache's full-hit payload (ISSUE 10), captured on the
+        # already-warm final signature class so plain decode iterations
+        # pay nothing for it
+        assert entry["max_host_visible_outputs"] <= 2
         assert entry["max_host_callbacks"] == 0
+        # the prefix-cache engine variant: same program logic over the
+        # arena-extended cache, same two-signature budget
+        arena = contract["entries"]["serving.iteration_prefix"]
+        assert arena["max_signatures"] == 2
+        assert arena["donate"] == ["cache"]

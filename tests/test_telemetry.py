@@ -572,10 +572,12 @@ def test_telemetry_smoke_gate(tmp_path):
     summary = json.loads(
         [l for l in out.stdout.splitlines() if l.startswith('{"flight_file')][0]
     )
-    # 3 chunked + 3 monolithic + 3 fused completions, 1 mid-prefill
-    # deadline drill
+    # 3 chunked + 3 monolithic + 3 fused + 6 prefix-cache cold/warm
+    # completions, 1 mid-prefill deadline drill — the warm round's
+    # full-hit requests (no prefill span at all) must still close their
+    # serve.request chains typed
     assert summary["request_outcomes"] == {
-        "completed": 9, "deadline_exceeded": 1,
+        "completed": 15, "deadline_exceeded": 1,
     }
     assert summary["prefill_chunk_spans"] >= 2
     assert summary["interference_max_gap_ms"] > 0

@@ -104,6 +104,32 @@ def build_entry_points() -> List[EntryPoint]:
     k_img = max(int((1 - cfg.filter_thres) * dalle.total_tokens), 1)
     i32 = SDS((), jnp.int32)
 
+    # the prefix-cache engine variant (serving/prefix_cache.py): arena
+    # rows appended to the BATCHED pools only — the one config knob that
+    # changes a serving-jit cache aval. Arena sizing mirrors
+    # Engine.__init__ exactly, via the engine's own helpers, so the
+    # committed contract tracks the code, not a transcription of it.
+    from dalle_pytorch_tpu.ops import kv_policy
+    from dalle_pytorch_tpu.serving.engine import (
+        _append_arena_rows, arena_rows_for,
+    )
+    from dalle_pytorch_tpu.serving.scheduler import pages_for
+
+    page = kv_policy.page_size()
+    n_pages_slot = pages_for(T + dalle.image_seq_len, page)
+    arena_rows = arena_rows_for(None, pages_for(T, page), n_pages_slot)
+    cacheB_arena = jax.eval_shape(
+        lambda c: _append_arena_rows(c, arena_rows), cacheB
+    )
+    # the cached terminal logits (the full-hit payload): the prefill
+    # jits' third output, derived abstractly from the same trace
+    logits1 = jax.eval_shape(
+        lambda p, c, i, k: eng._prefill_jit.__wrapped__(
+            dalle, p, c, i, k, k_img, 1.0
+        ),
+        params, cache1, internal, key,
+    )[2]
+
     # chunk widths exactly as the engine schedules them: simulate the
     # REAL Engine._next_chunk (1-token tails merged) over (T, chunk)
     shim = SimpleNamespace(config=cfg, T=T)
@@ -210,6 +236,66 @@ def build_entry_points() -> List[EntryPoint]:
                 "steady",
                 (dalle, params, cacheB, SDS((B,), jnp.int32),
                  SDS((B,), jnp.int32), keysB, k_img, 1.0),
+            )],
+        ),
+        EntryPoint(
+            name="serving.iteration_prefix",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_iteration_jit",
+            fn=eng._iteration_jit,
+            lower=eng._iteration_jit.lower,
+            static_argnums=(0, 9, 10, 12),
+            donate={"cache": 2},
+            # the prefix-cache engine's fused pair: the SAME program
+            # logic over the arena-extended batched cache (extra storage
+            # rows are content-only — tables/descriptors keep the B-wide
+            # shape, so the signature count stays exactly two)
+            signatures=[
+                Signature(
+                    "steady_arena",
+                    (dalle, params, cacheB_arena, SDS((B, T), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.bool_), keysB,
+                     cfg.prefill_chunk, k_img, 1.0, False),
+                ),
+                Signature(
+                    "final_arena",
+                    (dalle, params, cacheB_arena, SDS((B, T), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.bool_), keysB,
+                     cfg.prefill_chunk, k_img, 1.0, True),
+                ),
+            ],
+        ),
+        EntryPoint(
+            name="serving.decode_prefix",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_decode_jit",
+            fn=eng._decode_jit,
+            lower=eng._decode_jit.lower,
+            static_argnums=(0, 6),
+            donate={"cache": 2},
+            # prefix-cache split engine: decode over the arena-extended
+            # cache — still EXACTLY one steady signature
+            signatures=[Signature(
+                "steady_arena",
+                (dalle, params, cacheB_arena, SDS((B,), jnp.int32),
+                 SDS((B,), jnp.int32), keysB, k_img, 1.0),
+            )],
+        ),
+        EntryPoint(
+            name="serving.sample_cached",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_sample_cached_jit",
+            fn=eng._sample_cached_jit,
+            lower=eng._sample_cached_jit.lower,
+            static_argnums=(2,),
+            donate={},
+            # the full-prefix-hit first token: top-k + categorical over
+            # the CACHED terminal logits — the only program a full hit
+            # dispatches before entering decode
+            signatures=[Signature(
+                "hit", (logits1, key, k_img, 1.0),
             )],
         ),
         _train_entry(dalle, B),

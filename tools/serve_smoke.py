@@ -2,12 +2,17 @@
 """Serving-engine release gate: continuous-batching passes on CPU.
 
 Builds a tiny DALLE in-process (no checkpoint needed) and drives the full
-engine lifecycle three times — CHUNKED prefill (budget-bounded prompt
+engine lifecycle four times — CHUNKED prefill (budget-bounded prompt
 chunks interleaved with decode; the production serving shape),
-monolithic, and FUSED (the whole iteration as one ragged
-``_iteration_jit`` dispatch; ROADMAP 1) — verifying the accounting
-invariant each time: every request ends in a typed outcome, all pages
-return to the pool, and all three modes produce BIT-identical tokens.
+monolithic, FUSED (the whole iteration as one ragged ``_iteration_jit``
+dispatch; ROADMAP 1), and a PREFIX-CACHE cold/warm replay (ROADMAP 3:
+the same 3-request scenario twice through one engine with the
+content-addressed page index on; the warm round must hit and match the
+cold round bitwise) — verifying the accounting invariant each time:
+every request ends in a typed outcome, all pages return to the pool
+(the prefix pass additionally checks refcount accounting — references
+== mapped table entries, no leaks after drain), and all modes produce
+BIT-identical tokens.
 A further deterministic drill (FakeClock) lands a deadline MID-PREFILL
 and asserts the pages come back that iteration. Exit 0 iff all requests
 of all three passes COMPLETE and the drill terminates typed — the gate
@@ -17,9 +22,14 @@ a release pipeline runs before shipping a serving build::
 
 Composes with the fault registry for pipeline fault drills. The chunked
 pass runs FIRST, so an armed ``prefill_fail`` fires at CHUNK granularity
-and the retry must resume from the last completed chunk::
+and the retry must resume from the last completed chunk; an armed
+``prefix_hash_collide`` forges a warm-round probe (token verification
+must degrade it to cold prefill, tokens still bit-identical) and
+``prefix_publish_fail`` drops a cold-round publish (fail-open — later
+rounds republish)::
 
     DALLE_TPU_FAULTS="prefill_fail=1" python tools/serve_smoke.py
+    DALLE_TPU_FAULTS="prefix_hash_collide=1" python tools/serve_smoke.py
 
 ``--replicas N`` additionally drives the replicated front door
 (serving/router.py) through a chaos drill: N replicas serve 2N chunked
@@ -210,7 +220,70 @@ def main(argv=None) -> int:
     # (chunk-granular prefill_fail with resume-from-last-chunk)
     fused = run_pass("fused", prefill_chunk=2, fused_iteration=True)
 
+    # prefix-cache cold/warm replay (ROADMAP 3): ONE engine with the
+    # content-addressed page index runs the SAME 3-request scenario
+    # twice. The cold round publishes every prompt's pages; the warm
+    # round must HIT (> 0 probes matched) and produce tokens
+    # bit-identical to the cold round — the cross-request reuse contract
+    # — with the refcount accounting (sum of references == mapped table
+    # entries; no leaked pages after drain) asserted through the same
+    # public verify_invariants the other passes use
+    prefix_engine = Engine(dalle, params, EngineConfig(
+        max_batch=2, prefill_chunk=2, prefix_cache=True,
+    ))
+
+    def run_prefix_round(label: str) -> dict:
+        for i in range(3):
+            rejected = prefix_engine.submit(Request(
+                request_id=f"smoke{i}.{label}", prompt=prompts[i],
+                max_new_tokens=dalle.image_seq_len, seed=i,
+            ))
+            assert rejected is None, rejected
+        prefix_engine.run(max_steps=1000)
+        prefix_engine.verify_invariants(idle=True)
+        results = {
+            rid.split(".")[0]: res
+            for rid, res in prefix_engine.results.items()
+            if rid.endswith(f".{label}")
+        }
+        for rid in sorted(results):
+            print(json.dumps({"pass": label, **results[rid].to_json()}))
+        print(json.dumps({
+            "pass": label, "stats": prefix_engine.stats(),
+            "prefix": {"hits": prefix_engine.prefix.stats.hits,
+                       "misses": prefix_engine.prefix.stats.misses,
+                       "pages": len(prefix_engine.prefix)},
+        }))
+        return results
+
+    cold = run_prefix_round("prefix_cold")
+    hits_before_warm = prefix_engine.prefix.stats.hits
+    warm = run_prefix_round("prefix_warm")
+
     ok = True
+    if prefix_engine.prefix.stats.hits <= hits_before_warm:
+        ok = False
+        print("serve smoke FAILED: warm prefix round never hit the index",
+              file=sys.stderr)
+    for rid in sorted(cold):
+        for round_name, res in (("cold", cold[rid]), ("warm", warm[rid])):
+            if res.outcome is not Outcome.COMPLETED:
+                ok = False
+                print(f"serve smoke FAILED: {rid} {round_name} prefix round "
+                      f"did not complete ({res.outcome.value})",
+                      file=sys.stderr)
+        if not np.array_equal(
+            np.asarray(cold[rid].tokens), np.asarray(warm[rid].tokens)
+        ):
+            ok = False
+            print(f"serve smoke FAILED: {rid} warm (cache-hit) tokens "
+                  "diverge from the cold round", file=sys.stderr)
+        if not np.array_equal(
+            np.asarray(cold[rid].tokens), np.asarray(chunked[rid].tokens)
+        ):
+            ok = False
+            print(f"serve smoke FAILED: {rid} prefix-engine tokens diverge "
+                  "from the uncached chunked pass", file=sys.stderr)
     for rid in sorted(mono):
         ok = ok and mono[rid].outcome is Outcome.COMPLETED
         ok = ok and chunked[rid].outcome is Outcome.COMPLETED
@@ -262,8 +335,9 @@ def main(argv=None) -> int:
     if not ok:
         print("serve smoke FAILED: not every request completed", file=sys.stderr)
         return 1
-    print("serve smoke OK: 3/3 completed chunked, monolithic AND fused "
-          "(bit-identical), mid-prefill deadline drill typed, pool drained"
+    print("serve smoke OK: 3/3 completed chunked, monolithic, fused AND "
+          "the prefix-cache cold/warm replay (bit-identical, warm round "
+          "hit the index), mid-prefill deadline drill typed, pool drained"
           + (f", {2 * n_replicas}/{2 * n_replicas} completed the "
              f"{n_replicas}-replica crash drill bit-identically"
              if n_replicas else ""),

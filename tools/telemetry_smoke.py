@@ -12,7 +12,9 @@ release needs (docs/DESIGN.md §9):
 2. every serving request appears as a ``serve.request`` span chain
    ending in a typed outcome that sums to the engine's own counters —
    including the CHUNKED-prefill pass, whose ``serve.prefill_chunk``
-   spans and ``serve.ttft_s`` histogram must be present;
+   spans and ``serve.ttft_s`` histogram must be present, and the
+   prefix-cache cold/warm replay, whose warm full-hit requests open no
+   prefill span at all yet must still close their chains typed;
 3. the ``/metrics`` exposition renders (every sample line parses as
    ``name{...} value``);
 4. the long-prompt-arrival-during-steady-decode interference scenario
