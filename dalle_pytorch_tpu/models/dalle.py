@@ -75,6 +75,11 @@ class DALLE(nn.Module):
     loss_img_weight: float = 7.0
     stable: bool = False
     shift_tokens: bool = True
+    # extra token-shift ring rows (speculative-decode rollback slack; see
+    # ops/layers.py:PreShiftToken.pad) — cache-shape only, parameters are
+    # identical at every value, so a serving engine may clone the model
+    # with a wider ring without touching the checkpoint
+    shift_pad: int = 0
     rotary_emb: bool = True
     remat: bool = False
     sparse_layout_seed: int = 0
@@ -161,6 +166,7 @@ class DALLE(nn.Module):
             image_fmap_size=self.image_fmap_size,
             stable=self.stable,
             shift_tokens=self.shift_tokens,
+            shift_pad=self.shift_pad,
             rotary_emb=self.rotary_emb,
             remat=self.remat,
             sparse_layout_seed=self.sparse_layout_seed,
@@ -458,6 +464,8 @@ class DALLE(nn.Module):
         final: jnp.ndarray,
         mask: Optional[jnp.ndarray] = None,
         rowwise_head: bool = True,
+        all_logits: bool = False,
+        depth_limit: Optional[int] = None,
     ) -> jnp.ndarray:
         """One RAGGED block step: a whole mixed prefill+decode serving
         iteration through the transformer in ONE program ("Ragged Paged
@@ -493,6 +501,35 @@ class DALLE(nn.Module):
         last valid position (garbage for idle/non-final intermediate
         rows — the engine discards them by kind). Requires the paged
         cache format and no gMLP layers, like every ragged-offset path.
+
+        Speculative decoding (serving/engine.py) adds two STATIC knobs:
+
+        ``all_logits`` returns (b, W, num_image_tokens) logits at EVERY
+        block column — the k-token VERIFY head: a verify row's column j
+        predicts position start + j + 1, so one ragged dispatch yields
+        the target distribution for all k drafted positions. The head is
+        one M=(b*W) gemm whose per-row results are bitwise equal to the
+        M=b last-column gemm on the f32 parity tier (row-independent dot
+        accumulation — the same cross-shape contract that makes
+        fused == split); ``rowwise_head`` still overlays the per-row M=1
+        head at final-chunk rows' last valid column, so a prefill
+        completing inside a speculative iteration keeps split-path
+        bit-parity for its first-token logits.
+
+        ``depth_limit`` runs only the first L layers — the early-exit
+        self-draft pass (the final norm + head apply to layer L's
+        output). Draft quality is whatever the truncated stack gives;
+        correctness never depends on it (exact acceptance re-derives
+        every token from the full-depth verify logits).
+
+        The block is ANCHORED at the descriptor ``start`` (attention
+        write base, rotary/mask rows, shift-ring reads all derive from
+        it rather than the stored cache indices), which is what lets a
+        speculative rollback be pure descriptor arithmetic: a rejected
+        suffix is simply overwritten by the next block dispatched at the
+        accepted frontier. For non-speculative callers the stored
+        indices equal ``start`` and the anchored arithmetic is
+        value-identical.
         """
         b, n = tokens.shape
         assert "mlp" not in tuple(self.attn_types or ("full",)), (
@@ -527,11 +564,28 @@ class DALLE(nn.Module):
             deterministic=True,
             decode=True,
             block_len=length,
+            block_start=start,
+            depth_limit=depth_limit,
         )
         last = jnp.clip(length - 1, 0, n - 1)
         h_last = jnp.take_along_axis(
             out, last[:, None, None], axis=1
         )  # (b, 1, dim)
+        if all_logits:
+            # the k-token verify head: logits at EVERY column, one
+            # M=(b*W) gemm; final rows' last valid column is overlaid
+            # with the per-row M=1 split-parity head below
+            cols = self._head_image(out)  # (b, W, V_img)
+            if rowwise_head:
+                rowwise = jnp.concatenate(
+                    [self._head_image(h_last[i:i + 1]) for i in range(b)],
+                    axis=0,
+                )[:, 0]  # per-row M=1 — the split prefill head
+                sel = final[:, None] & (
+                    jnp.arange(n, dtype=jnp.int32)[None] == last[:, None]
+                )
+                cols = jnp.where(sel[..., None], rowwise[:, None, :], cols)
+            return cols
         batched = self._head_image(h_last)[:, 0]  # (b, V_img), M=b gemm
         if b == 1 or not rowwise_head:
             return batched

@@ -81,6 +81,9 @@ class Transformer(nn.Module):
     image_fmap_size: Optional[int] = None
     stable: bool = False
     shift_tokens: bool = False
+    # extra token-shift ring rows — speculative-decode rollback slack
+    # (ops/layers.py:PreShiftToken.pad); 0 for every non-speculative model
+    shift_pad: int = 0
     rotary_emb: bool = True
     remat: bool = False
     sparse_layout_seed: int = 0
@@ -196,9 +199,11 @@ class Transformer(nn.Module):
                     image_size=self.image_fmap_size,
                     seq_len=self.seq_len,
                     pass_decode=True,
+                    pad=self.shift_pad,
                 )
                 ff = PreShiftToken(
-                    fn=ff, image_size=self.image_fmap_size, seq_len=self.seq_len
+                    fn=ff, image_size=self.image_fmap_size,
+                    seq_len=self.seq_len, pad=self.shift_pad,
                 )
 
             attn_blocks.append(
@@ -228,7 +233,7 @@ class Transformer(nn.Module):
     # ------------------------------------------------------------------ call
 
     def _block_kwargs(self, ind: int, mask, rot, deterministic, decode,
-                      block_len=None):
+                      block_len=None, block_start=None):
         """(attn kwargs, ff kwargs) for layer ``ind`` in module-call form."""
         kind = self.layer_kinds[ind]
         akw: dict = dict(deterministic=deterministic, decode=decode)
@@ -236,6 +241,8 @@ class Transformer(nn.Module):
             akw.update(mask=mask, rotary_pos_emb=rot)
             if block_len is not None:
                 akw["block_len"] = block_len
+            if block_start is not None:
+                akw["block_start"] = block_start
         fkw: dict = dict(deterministic=deterministic)
         if self.shift_tokens:
             fkw.update(decode=decode)
@@ -243,6 +250,8 @@ class Transformer(nn.Module):
                 # the FF-side PreShiftToken consumes block_len for its own
                 # ragged ring advance (it never forwards it to the FF)
                 fkw["block_len"] = block_len
+            if block_start is not None:
+                fkw["block_start"] = block_start
         return akw, fkw
 
     def __call__(
@@ -252,6 +261,8 @@ class Transformer(nn.Module):
         deterministic: bool = True,
         decode: bool = False,
         block_len: Optional[jnp.ndarray] = None,
+        block_start: Optional[jnp.ndarray] = None,
+        depth_limit: Optional[int] = None,
     ) -> jnp.ndarray:
         rot_np = self.rotary_table()
         # a content-interned StaticTable, not a traced array: the attention
@@ -277,11 +288,21 @@ class Transformer(nn.Module):
             or decode
             or (not self.reversible and not self.remat)
         )
+        # depth_limit (static): run only the first L layers — the
+        # early-exit self-draft pass of speculative decoding
+        # (serving/engine.py). Decode-mode only: training/prefill always
+        # runs the full stack. None (every non-speculative caller) is the
+        # full depth.
+        depth_eff = (
+            self.depth if depth_limit is None
+            else min(max(int(depth_limit), 1), self.depth)
+        )
 
         if sequential and not self.reversible:
-            for ind in range(self.depth):
+            for ind in range(depth_eff):
                 akw, fkw = self._block_kwargs(
-                    ind, mask, rot, deterministic, decode, block_len
+                    ind, mask, rot, deterministic, decode, block_len,
+                    block_start,
                 )
                 x = x + self.attn_blocks[ind](x, **akw)
                 x = x + self.ff_blocks[ind](x, **fkw)
@@ -290,9 +311,10 @@ class Transformer(nn.Module):
         if self.reversible and (self.is_initializing() or decode):
             # reversible wiring, run directly (no custom VJP needed)
             x1, x2 = x, x
-            for ind in range(self.depth):
+            for ind in range(depth_eff):
                 akw, fkw = self._block_kwargs(
-                    ind, mask, rot, deterministic, decode, block_len
+                    ind, mask, rot, deterministic, decode, block_len,
+                    block_start,
                 )
                 x1 = x1 + self.attn_blocks[ind](x2, **akw)
                 x2 = x2 + self.ff_blocks[ind](x1, **fkw)

@@ -260,6 +260,7 @@ class PatternAttention(nn.Module):
         decode: bool = False,
         force_dense: bool = False,
         block_len: Optional[jnp.ndarray] = None,
+        block_start: Optional[jnp.ndarray] = None,
     ) -> jnp.ndarray:
         b, n, _ = x.shape
         h, d = self.heads, self.dim_head
@@ -312,7 +313,8 @@ class PatternAttention(nn.Module):
                     t.reshape(b, n, h, d) for t in jnp.split(qkv, 3, axis=-1)
                 )
                 out = self._decode_attend(
-                    q, k, v, mask, rotary_pos_emb, block_len=block_len
+                    q, k, v, mask, rotary_pos_emb, block_len=block_len,
+                    block_start=block_start,
                 )
                 out = out.reshape(b, n, inner)
         else:
@@ -787,7 +789,8 @@ class PatternAttention(nn.Module):
         ck = self.get_variable("cache", "cached_key")
         return ck.shape[1] != self.seq_len
 
-    def _decode_attend(self, q, k, v, mask, rotary_pos_emb, block_len=None):
+    def _decode_attend(self, q, k, v, mask, rotary_pos_emb, block_len=None,
+                       block_start=None):
         """Decode against an n-major (b, W, h, d) K/V cache: single-token
         steps or multi-token prefill blocks (n > 1, e.g. the text prompt in
         one parallel pass). Each new token's row of the pattern mask selects
@@ -812,13 +815,14 @@ class PatternAttention(nn.Module):
         b, n, h, d = q.shape
         if self._cache_format(b) == "paged":
             return self._decode_attend_paged(
-                q, k, v, mask, rotary_pos_emb, block_len=block_len
+                q, k, v, mask, rotary_pos_emb, block_len=block_len,
+                block_start=block_start,
             )
-        if block_len is not None:
+        if block_len is not None or block_start is not None:
             raise ValueError(
-                "ragged blocks (block_len) need the paged cache format: "
-                "the flat/4d formats' scalar cache index cannot advance "
-                "per row"
+                "ragged blocks (block_len/block_start) need the paged cache "
+                "format: the flat/4d formats' scalar cache index cannot "
+                "advance per row"
             )
 
         cached_key, cached_value, cache_index, is_init = self._decode_caches(
@@ -881,7 +885,7 @@ class PatternAttention(nn.Module):
         return k_pool, v_pool, table, cache_index, is_init
 
     def _decode_attend_paged(self, q, k, v, mask, rotary_pos_emb,
-                             block_len=None):
+                             block_len=None, block_start=None):
         """Decode against the block-paged cache: rotary rows, pattern-mask
         rows, and the write position are all indexed PER SEQUENCE from the
         (b,) cache index, so a batch whose sequences sit at different
@@ -907,7 +911,20 @@ class PatternAttention(nn.Module):
         fused-vs-split engine parity bitwise on the f32 CPU tier. Invalid
         columns
         compute garbage that is finite (clipped mask rows keep at least
-        one key visible) and discarded by the caller."""
+        one key visible) and discarded by the caller.
+
+        ``block_start`` (b,), optional (requires ``block_len``): anchor the
+        block at the DESCRIPTOR's position instead of the stored cache
+        index — the speculative-decode rewind (serving/engine.py). A
+        verify block writes its full padded length, but only
+        ``accepted`` positions survive; the next descriptor's
+        block_start lags the stored index by the rejected count, and
+        anchoring the write base, rotary rows, and mask rows there makes
+        the rejected positions plain overwrites: garbage K/V beyond the
+        anchor frontier is causally masked until the next block lands on
+        it. With block_start equal to the stored index (every
+        non-speculative fused dispatch) the arithmetic is value-identical
+        to the unanchored form."""
         from . import paged_kv, ragged_attention
 
         b, n, h, d = q.shape
@@ -917,7 +934,14 @@ class PatternAttention(nn.Module):
         if is_init:
             return jnp.zeros_like(q)
 
-        idx = cache_index.value  # (b,)
+        if block_start is not None:
+            assert block_len is not None, (
+                "block_start anchoring is a ragged-block feature: pass "
+                "block_len"
+            )
+            idx = block_start  # (b,) descriptor anchor
+        else:
+            idx = cache_index.value  # (b,)
         pos = idx[:, None] + jnp.arange(n, dtype=idx.dtype)[None]  # (b, n)
         if rotary_pos_emb is not None:
             T = rotary_pos_emb.shape[0]
@@ -936,7 +960,14 @@ class PatternAttention(nn.Module):
             v_pool.value, table.value, idx, v.reshape(b, n, hd),
             limit=block_len,
         )
-        cache_index.value = idx + (n if block_len is None else block_len)
+        if block_start is not None:
+            # idle rows (block_len 0) carry garbage descriptors; their
+            # stored index passes through untouched
+            cache_index.value = jnp.where(
+                block_len > 0, idx + block_len, cache_index.value
+            )
+        else:
+            cache_index.value = idx + (n if block_len is None else block_len)
 
         causal_full = self.attn_type == "full" and self.causal
         if (

@@ -285,15 +285,27 @@ class PreShiftToken(nn.Module):
     and row-above features the shift needs, so KV-cached sampling stays O(1)
     per step. ``pass_decode`` controls whether the wrapped fn also receives
     the decode flag (attention does, feed-forward doesn't).
+
+    ``pad`` widens the ring by that many EXTRA rows of history — the
+    speculative-decode rollback slack (serving/engine.py): a verify block
+    of k tokens advances the ring by k, but only ``accepted <= k``
+    positions survive, so the next block's descriptor ``block_start`` may
+    LAG the stored high-water mark by up to ``pad`` positions and every
+    read it needs (prev token, row-above) must still be resident. With
+    ``pad=0`` (every non-speculative model) the ring is exactly the
+    original ``image_size + 1`` rows and the anchored index arithmetic
+    below reduces to the unanchored offsets bit-for-bit.
     """
 
     fn: nn.Module
     image_size: int
     seq_len: int
     pass_decode: bool = False
+    pad: int = 0
 
     @nn.compact
-    def __call__(self, x, decode: bool = False, block_len=None, **kwargs):
+    def __call__(self, x, decode: bool = False, block_len=None,
+                 block_start=None, **kwargs):
         img_seq_len = self.image_size**2
         text_len = self.seq_len - img_seq_len + 1
         inner_kwargs = dict(kwargs)
@@ -301,6 +313,8 @@ class PreShiftToken(nn.Module):
             inner_kwargs["decode"] = decode
             if block_len is not None:
                 inner_kwargs["block_len"] = block_len
+            if block_start is not None:
+                inner_kwargs["block_start"] = block_start
 
         if not decode:
             x = shift_tokens(x, text_len, self.image_size)
@@ -319,7 +333,7 @@ class PreShiftToken(nn.Module):
         # bit-identical — every read the ring
         # cannot serve (pos 0's "previous", out-of-grid row-above) is already
         # masked to zero inside shift_tokens_decode / the prefill rule.
-        R = self.image_size + 1
+        R = self.image_size + 1 + self.pad
         is_init = not self.has_variable("cache", "shift_hist")
         hist = self.variable("cache", "shift_hist", jnp.zeros, (b, R, d), x.dtype)
         pos_var = self.variable("cache", "shift_index", lambda: jnp.array(0, jnp.int32))
@@ -330,30 +344,71 @@ class PreShiftToken(nn.Module):
         if block_len is not None:
             # RAGGED block (the fused serving iteration): row b's valid
             # tokens are columns [0, block_len[b]) at positions
-            # pos[b] + j, mixing text (prefill rows) and image (decode
+            # anchor[b] + j, mixing text (prefill rows) and image (decode
             # rows) — the per-position decode rules apply elementwise.
-            # ``cat`` maps any position pos[b] + t (t in [-R, n)) to
+            # ``cat`` maps any position anchor[b] + t (t in [-R, n)) to
             # column R + t: prev is position p-1 (column R+j-1), the
             # row-above token p - image_size (column R+j-image_size;
-            # R = image_size + 1 keeps both indices >= 0). The ring then
+            # R >= image_size + 1 keeps both indices >= 0). The ring then
             # advances PER ROW by block_len — a pure gather, bitwise
             # equal to the split paths' concatenate update at the same
             # advance (idle rows advance 0 and keep their ring intact).
+            #
+            # ``block_start`` anchors the block at the DESCRIPTOR's
+            # position instead of the stored high-water mark: after a
+            # speculative verify commits only ``accepted`` of its
+            # block_len tokens (serving/engine.py), the next descriptor
+            # lags the stored index by delta = pos - block_start, and
+            # every ring read below the anchor shifts down by delta —
+            # the per-row cache rewind, realized as index arithmetic on
+            # the (pad-widened) ring rather than a device round trip.
+            # The rows the over-advance polluted (positions >= anchor)
+            # are never read from the ring: in-block positions gather
+            # from ``x`` itself. With block_start == pos (every
+            # non-speculative dispatch) delta is 0 and every index
+            # below equals the unanchored form.
             assert jnp.ndim(pos) == 1, (
                 "ragged blocks need a vectorized (b,) shift index "
                 "(models/sampling.py:set_decode_offsets)"
             )
             jidx = jnp.arange(n, dtype=jnp.int32)
             cat = jnp.concatenate((hist.value, x), axis=1)  # (b, R+n, d)
-            prev = cat[:, R - 1 + jidx]                     # (b, n, d)
-            row_above = cat[:, R - self.image_size + jidx]
-            pos_bj = pos[:, None] + jidx[None]              # (b, n)
-            take = jnp.minimum(
-                jnp.arange(R, dtype=jnp.int32)[None] + block_len[:, None],
-                R + n - 1,
+            if block_start is None:
+                anchor = pos
+                delta = jnp.zeros_like(pos)
+            else:
+                anchor = block_start
+                # idle rows (block_len 0) carry garbage descriptors; pin
+                # them to delta 0 so their ring state passes through
+                delta = jnp.where(
+                    block_len > 0, jnp.maximum(pos - block_start, 0), 0
+                )
+            prev_ix = jnp.where(
+                jidx[None] == 0, R - 1 - delta[:, None], R - 1 + jidx[None]
             )
+            prev = jnp.take_along_axis(cat, prev_ix[..., None], axis=1)
+            above_ix = (
+                R - self.image_size + jidx[None]
+                - jnp.where(jidx[None] >= self.image_size, 0, 1)
+                * delta[:, None]
+            )
+            row_above = jnp.take_along_axis(
+                cat, jnp.clip(above_ix, 0, R + n - 1)[..., None], axis=1
+            )
+            pos_bj = anchor[:, None] + jidx[None]           # (b, n)
+            take = (
+                jnp.arange(R, dtype=jnp.int32)[None] + block_len[:, None]
+                - jnp.where(
+                    jnp.arange(R, dtype=jnp.int32)[None]
+                    >= R - block_len[:, None],
+                    0, 1,
+                ) * delta[:, None]
+            )
+            take = jnp.clip(take, 0, R + n - 1)
             hist.value = jnp.take_along_axis(cat, take[..., None], axis=1)
-            pos_var.value = pos + block_len
+            pos_var.value = jnp.where(
+                block_len > 0, anchor + block_len, pos
+            )
             x = shift_tokens_decode(
                 x, pos_bj, prev, row_above, text_len, self.image_size
             )
@@ -377,7 +432,10 @@ class PreShiftToken(nn.Module):
             x = jnp.concatenate((prev_block[..., :half], x[..., half:]), axis=-1)
         else:
             prev = hist.value[:, R - 1 :]  # position pos - 1
-            row_above = hist.value[:, 1:2]  # position pos - image_size
+            # position pos - image_size: ring row R - image_size (== 1
+            # for the unpadded ring)
+            ra = R - self.image_size
+            row_above = hist.value[:, ra : ra + 1]
             pos_var.value = pos + 1
             hist.value = jnp.concatenate((hist.value[:, 1:], x), axis=1)
             x = shift_tokens_decode(x, pos, prev, row_above, text_len, self.image_size)

@@ -220,7 +220,15 @@ def copy_pages_across(
     ``valid[i]`` are ZEROED, not preserved: a published terminal page
     must not leak the publisher's image K/V, and a COW'd page must
     satisfy the zeros-past-frontier sweep contract even when the
-    destination page held stale content."""
+    destination page held stale content.
+
+    An OUT-OF-RANGE ``dst`` id (>= the destination's page count) DROPS
+    that copy entirely (scatter mode="drop") — the padding convention of
+    the serving engine's fixed-shape donated copy jit
+    (serving/engine.py:_copy_pages_jit): call vectors pad to one static
+    length with dst = the sentinel, so every publish/COW/restore shares
+    one compile signature. In-range ids behave exactly as before (the
+    drop mode only changes what out-of-range writes do)."""
     src = jnp.asarray(src, jnp.int32)
     dst = jnp.asarray(dst, jnp.int32)
     content = flat_view(src_pool)[src]  # (k, page, feat)
@@ -231,7 +239,10 @@ def copy_pages_across(
             < jnp.asarray(valid, jnp.int32)[:, None]
         )
         content = jnp.where(keep[..., None], content, 0)
-    return flat_view(dst_pool).at[dst].set(content).reshape(dst_pool.shape)
+    return (
+        flat_view(dst_pool).at[dst].set(content, mode="drop")
+        .reshape(dst_pool.shape)
+    )
 
 
 def copy_pages(pool: jnp.ndarray, src, dst, valid=None) -> jnp.ndarray:

@@ -173,6 +173,34 @@ class EngineConfig:
     # CPU parity tier
     # (tests/test_ragged_attention.py, tools/serve_smoke.py --fused).
     fused_iteration: bool = False
+    # speculative decoding through the fused iteration (ROADMAP 2): each
+    # decoding slot self-drafts up to ``spec_k`` tokens per iteration (an
+    # in-trace chain of single-token draft steps over the SAME checkpoint
+    # — no second model) and the fused dispatch VERIFIES them as one
+    # ragged descriptor row of width spec_k+1, committing the exact-match
+    # accepted prefix plus one bonus target sample. Acceptance compares
+    # the drafted token against the token the target model samples with
+    # the same (seed, position) fold-in key, so speculative output is
+    # BIT-IDENTICAL to non-speculative decode by construction — the
+    # drafter only moves the accept rate, never the tokens. Rejected
+    # positions roll back via descriptor anchoring: the next block
+    # re-dispatches at the accepted frontier and simply overwrites them
+    # (masked append / per-row limit + per-row cache-index rewind;
+    # ops/attention.py:_decode_attend_paged, ops/layers.py:
+    # PreShiftToken). Requires fused_iteration; forces synchronous
+    # sample readback (the host needs the accepted count to build the
+    # next descriptors — the sync is amortized over up to spec_k+1
+    # tokens per step). Off by default pending TPU measurement.
+    spec_decode: bool = False
+    # drafted tokens per slot per iteration (>= 1); the verify row width
+    # is spec_k + 1 and the fused block width max(prefill_chunk, spec_k+1)
+    spec_k: int = 3
+    # early-exit drafter depth: run only the first N layers for draft
+    # steps (the truncated-depth self-draft). None = full depth — the
+    # EXACT drafter, whose drafts reproduce the target samples bitwise on
+    # the f32 parity tier (accept rate 1.0); useful as the correctness
+    # harness and as the upper bound the truncated drafter trades away.
+    spec_draft_depth: Optional[int] = None
     # cross-request prefix caching (serving/prefix_cache.py, ROADMAP 3):
     # content-addressed immutable prompt pages with refcounts. A probe at
     # admission maps every verified hit page into the slot's page table
@@ -410,6 +438,153 @@ def _iteration_jit(dalle: DALLE, params, cache, prompts, tok, start, length,
     return mutated["cache"], samples.astype(jnp.int32), None
 
 
+def spec_model(dalle: DALLE, spec_k: int) -> DALLE:
+    """The speculative-serving clone of a checkpointed model: identical
+    parameters, token-shift ring widened by ``spec_k`` rows — the
+    rollback slack that lets a rejected verify suffix be rewound by
+    descriptor arithmetic (ops/layers.py:PreShiftToken.pad). ONE
+    definition shared by ``Engine.__init__`` and the trace-audit
+    registry (tools/lint/trace/registry.py) so the committed contract's
+    cache avals derive from the code, not a transcription of it."""
+    if not dalle.shift_tokens:
+        return dalle
+    return dalle.clone(shift_pad=spec_k)
+
+
+def fused_width(config: EngineConfig) -> int:
+    """The fused iteration's static block width: the prefill chunk, or —
+    with speculation on — wide enough to carry a full verify row
+    (spec_k drafts plus the committed input token). Shared with the
+    trace-audit registry for the same no-transcription reason as
+    ``spec_model``."""
+    if config.spec_decode:
+        return max(config.prefill_chunk, config.spec_k + 1)
+    return config.prefill_chunk
+
+
+@partial(jax.jit, static_argnums=(0, 9, 10, 12, 13, 14),
+         donate_argnums=(2,))
+def _spec_iteration_jit(dalle: DALLE, params, cache, prompts, tok, start,
+                        length, final, base_keys, width: int, k: int,
+                        temperature, any_final: bool, spec_k: int,
+                        draft_depth: Optional[int]):
+    """One SPECULATIVE TokenBudget iteration as a single device dispatch
+    (ROADMAP 2): draft, verify, and accept without the host ever touching
+    a token value mid-step.
+
+    Descriptor semantics extend ``_iteration_jit``'s: a prefill-chunk row
+    is unchanged; a decode row becomes a VERIFY row of ``length`` =
+    1 + (drafted tokens), its columns carrying [tok, d_1, .., d_γ] at
+    positions start .. start+γ — the exact ragged (start, length, final)
+    shape the fused kernel already executes for prefill chunks, which is
+    the whole point: verifying k tokens streams the weights ONCE, like
+    decoding one.
+
+    In-trace stages:
+
+    1. DRAFT — ``spec_k`` sequential width-1 ``fused_step`` calls through
+       the first ``draft_depth`` layers (None = full depth, the exact
+       drafter), each sampling d_i with the SAME fold_in(seed, pos+i+1)
+       key the verify column will use. The draft threads a FUNCTIONAL
+       cache chain that is DISCARDED — the verify below starts from the
+       original cache value, so draft numerics can never leak into
+       committed state. (The chain's K/V writes cost XLA one copy of the
+       drafted layers' pools per iteration; acceptable on the CPU parity
+       tier, to be re-measured on TPU where a stash-based drafter is the
+       known upgrade.)
+
+    2. VERIFY — one ``fused_step`` over the full mixed block with
+       ``all_logits=True``: per-column image logits for every row, the
+       per-row M=1 split-parity head overlaid at final-chunk rows.
+
+    3. ACCEPT — sample every column with its own key (one flat vmapped
+       categorical — per-cell bitwise equal to the plain path's per-row
+       vmap), then take the longest prefix where draft == target sample
+       (exact-match acceptance: temperature/top-k sampling is
+       deterministic given the (seed, position) key, so this commits
+       BIT-IDENTICALLY what sequential decode would have produced —
+       between 1 and spec_k+1 tokens per row per step). ``accepted`` is
+       returned per row; the host advances positions by it, and the next
+       dispatch's descriptors land on the accepted frontier, overwriting
+       the rejected suffix (K/V) while the anchored shift-ring reads skip
+       it (PreShiftToken delta) — the rollback is descriptor arithmetic,
+       not a device round trip.
+
+    The cache is DONATED like every serving jit. Static ``any_final``
+    stays the one extra warm signature class (DTL11x: steady + final,
+    exactly two)."""
+    B, T = prompts.shape
+    j = jnp.arange(width, dtype=jnp.int32)[None]
+    chunk = jnp.take_along_axis(
+        prompts, jnp.minimum(start[:, None] + j, T - 1), axis=1
+    )
+    # the (B, W) sampling-key matrix, derived IN-TRACE from the per-slot
+    # base keys (``Engine._base_keys``, set once per admission): column
+    # j of row b is fold_in(key(seed_b), start_b + j + 1) — exactly the
+    # key sequential decode uses at that position (a verify row's column
+    # j predicts position start+j+1) AND, at a final chunk's last valid
+    # column, fold_in(key(seed), T) (the final chunk ends exactly at T:
+    # Engine._next_chunk_fused). One fused derivation instead of a
+    # per-column host key loop; unused columns fold garbage positions
+    # whose samples the acceptance mask and the caller discard.
+    keys = jax.vmap(
+        lambda kb, p: jax.vmap(lambda q: jax.random.fold_in(kb, q))(p)
+    )(base_keys, start[:, None] + j + 1)
+    is_verify = start >= T  # image positions = decode/verify rows
+    no_final = jnp.zeros((B,), bool)
+    d_len = jnp.where(is_verify, 1, 0).astype(jnp.int32)
+    draft_cache = cache
+    cur = tok
+    drafts = []
+    for i in range(spec_k):
+        dlog, dmut = dalle.apply(
+            {"params": params, "cache": draft_cache},
+            cur[:, None], start + i, d_len, no_final,
+            rowwise_head=False, depth_limit=draft_depth,
+            method=DALLE.fused_step, mutable=["cache"],
+        )
+        draft_cache = dmut["cache"]
+        dfilt = top_k_filter(dlog, k=k) / temperature
+        cur = jax.vmap(jax.random.categorical)(
+            keys[:, i], dfilt
+        ).astype(jnp.int32)
+        drafts.append(cur)
+    del draft_cache  # the chain is scratch; verify starts from `cache`
+
+    dec_row = jnp.concatenate(
+        [tok[:, None]] + [d[:, None] for d in drafts], axis=1
+    )
+    dec_row = jnp.pad(dec_row, ((0, 0), (0, width - 1 - spec_k)))
+    tokens = jnp.where(is_verify[:, None], dec_row, chunk)
+    logits, mutated = dalle.apply(
+        {"params": params, "cache": cache},
+        tokens, start, length, final,
+        rowwise_head=any_final, all_logits=True,
+        method=DALLE.fused_step, mutable=["cache"],
+    )  # (B, width, V_img)
+    filtered = top_k_filter(logits, k=k) / temperature
+    samples = jax.vmap(jax.random.categorical)(
+        keys.reshape(B * width), filtered.reshape(B * width, -1)
+    ).reshape(B, width).astype(jnp.int32)
+    if spec_k:
+        dmat = jnp.concatenate([d[:, None] for d in drafts], axis=1)
+        valid = (
+            jnp.arange(spec_k, dtype=jnp.int32)[None] < length[:, None] - 1
+        )
+        matched = valid & (dmat == samples[:, :spec_k])
+        m = jnp.cumprod(matched.astype(jnp.int32), axis=1).sum(axis=1)
+    else:
+        m = jnp.zeros((B,), jnp.int32)
+    accepted = jnp.where(is_verify & (length > 0), m + 1, 0)
+    if any_final:
+        last = jnp.clip(length - 1, 0, width - 1)
+        flogits = jnp.take_along_axis(
+            logits, last[:, None, None], axis=1
+        )[:, 0]
+        return mutated["cache"], samples, accepted, flogits
+    return mutated["cache"], samples, accepted, None
+
+
 @partial(jax.jit, static_argnums=(2,))
 def _sample_cached_jit(logits, key, k: int, temperature):
     """Sample a first image token from CACHED terminal prefill logits —
@@ -422,6 +597,46 @@ def _sample_cached_jit(logits, key, k: int, temperature):
     return jax.random.categorical(
         key, top_k_filter(logits, k=k) / temperature, axis=-1
     )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _copy_pages_jit(cache, src, dst, valid):
+    """Publish / copy-on-write page copies as ONE donated fixed-shape
+    dispatch (the PR 10 follow-on): the eager pool-sized ``.at[].set``
+    rewrites that used to run per publish/map now ride a single jit
+    whose src/dst/valid vectors are PADDED to the engine's fixed copy
+    width (``Engine._padded_copy``), so every call shares one compile
+    signature and stays inside the zero-in-trace-compile contract
+    (DTL11x; registry entry ``serving.page_copy``). Padding rows carry
+    an out-of-range dst id and are DROPPED by the scatter
+    (``paged_kv.copy_pages_across`` mode="drop"). The cache is donated —
+    the copy happens in the pool's own buffers, never double-buffering
+    it on the host path."""
+    def fn(path, x):
+        if getattr(path[-1], "key", None) in (
+            "cached_key_pages", "cached_value_pages"
+        ):
+            return paged_kv.copy_pages(x, src, dst, valid)
+        return x
+
+    return jax.tree_util.tree_map_with_path(fn, cache)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _copy_pages_across_jit(dst_cache, src_cache, src, dst, valid):
+    """The cross-pool variant of ``_copy_pages_jit``: the SPLIT engine's
+    partial-hit restore copies shared arena pages out of the batched
+    pools into a private batch-1 prefill cache (whose chunk jits cannot
+    reach the batched storage). Same fixed padded shape, destination
+    cache donated; registry entry ``serving.page_copy_across``."""
+    def fn(path, x1, xb):
+        if getattr(path[-1], "key", None) in (
+            "cached_key_pages", "cached_value_pages"
+        ):
+            return paged_kv.copy_pages_across(x1, xb, src, dst, valid)
+        return x1
+
+    return jax.tree_util.tree_map_with_path(fn, dst_cache, src_cache)
 
 
 def _append_arena_rows(cache, rows: int):
@@ -494,6 +709,26 @@ class Engine:
                 f"rows to the iteration width instead), got "
                 f"{config.prefill_chunk}"
             )
+        self.spec = config.spec_decode
+        if self.spec:
+            if not config.fused_iteration:
+                raise ValueError(
+                    "spec_decode runs THROUGH the fused iteration (a verify "
+                    "step is a ragged descriptor row of the single "
+                    "dispatch); enable fused_iteration"
+                )
+            if config.spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {config.spec_k}")
+            if config.spec_draft_depth is not None and not (
+                1 <= config.spec_draft_depth <= dalle.depth
+            ):
+                raise ValueError(
+                    f"spec_draft_depth must be in [1, {dalle.depth}] or "
+                    f"None (full depth), got {config.spec_draft_depth}"
+                )
+            # widen the token-shift ring by spec_k rows — the rollback
+            # slack (cache-shape only; parameters untouched)
+            dalle = spec_model(dalle, config.spec_k)
         self.dalle = dalle
         self.params = params
         self.config = config
@@ -620,7 +855,7 @@ class Engine:
                     "(prefill_chunk): the fused block width is the chunk "
                     "width"
                 )
-            self._W = config.prefill_chunk
+            self._W = fused_width(config)
             self._prompts = jnp.zeros((B, self.T), jnp.int32)
             # the fused jit donates the cache on its FIRST dispatch, when
             # it is still the pristine init tree — whose index leaves
@@ -628,6 +863,19 @@ class Engine:
             # shift_index the same offsets array). Donation forbids
             # aliased inputs; one copy de-aliases the tree once
             self.cache = jax.tree_util.tree_map(jnp.copy, self.cache)
+        # speculative-decode state: lifetime draft/accept tallies (the
+        # serve.spec_accept_frac gauge) and the per-slot BASE sampling
+        # keys — key(seed), written once per admission; the spec jit
+        # folds positions into them in-trace, so the synchronous hot
+        # loop never assembles keys on the host
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        if self.spec:
+            self._base_keys = jnp.stack([jax.random.key(0)] * B)
+        # fixed copy width for the donated publish/COW/restore page-copy
+        # jits (_copy_pages_jit): a publish copies at most the prompt's
+        # pages, a COW/restore fewer — one padded shape covers all
+        self._copy_pad = pages_for(self.T, self.page)
         # dispatch accounting (bench.py --serve): model-jit calls and
         # engine iterations that did device work — steady-state fused mode
         # is exactly 1 dispatch/iteration, the split path one per prefill
@@ -681,7 +929,10 @@ class Engine:
         self._sweep_terminations()
         self._admit()
         if self.fused:
-            worked = self._fused_iteration()
+            worked = (
+                self._spec_iteration() if self.spec
+                else self._fused_iteration()
+            )
         else:
             worked = self._decode_once()
             worked = self._advance_prefills() or worked
@@ -810,6 +1061,13 @@ class Engine:
             entry.clamped = clamped
             if clamped:
                 self.counters.inc("serve.clamped")
+            if self.spec:
+                # the slot's draft/verify BASE key, set once per
+                # admission (preemption replay re-admits through here):
+                # _spec_iteration_jit folds positions into it in-trace
+                self._base_keys = self._base_keys.at[free[0]].set(
+                    jax.random.key(entry.request.seed)
+                )
             prompt_pages = pages_for(self.T, self.page) - hit.shared
             ok = self.pool.alloc(entry.request_id, prompt_pages)
             assert ok, "admission checked worst-case > prompt pages"
@@ -947,12 +1205,8 @@ class Engine:
                 src = [n.page_id for n in nodes]
                 ring = nodes[-1].ring
 
-                def fn(path, x1, xb):
+                def fn(path, x1):
                     key = getattr(path[-1], "key", None)
-                    if key in ("cached_key_pages", "cached_value_pages"):
-                        return paged_kv.copy_pages_across(
-                            x1, xb, src, list(range(len(src)))
-                        )
                     if key == "shift_hist":
                         return x1.at[0].set(
                             ring[jax.tree_util.keystr(path)]
@@ -965,7 +1219,17 @@ class Engine:
                     return x1
 
                 slot.cache1 = jax.tree_util.tree_map_with_path(
-                    fn, slot.cache1, self.cache
+                    fn, slot.cache1
+                )
+                # arena -> batch-1 pool restore through the donated
+                # fixed-shape cross-pool copy jit (full pages: valid ==
+                # page size)
+                slot.cache1 = _copy_pages_across_jit(
+                    slot.cache1, self.cache, *self._padded_copy(
+                        src, list(range(len(src))),
+                        [self.page] * len(src),
+                        dst_total=self.n_pages_slot,
+                    )
                 )
                 self.prefix.release(nodes)
         slot.filled = s
@@ -1015,13 +1279,6 @@ class Engine:
             key = getattr(path[-1], "key", None)
             if key == "page_table":
                 return x.at[idx, : len(shared)].set(ids) if len(shared) else x
-            if key in ("cached_key_pages", "cached_value_pages"):
-                if cow:
-                    return paged_kv.copy_pages(
-                        x, [terminal.page_id],
-                        [idx * n_p + len(nodes) - 1], [terminal.valid],
-                    )
-                return x
             if key in ("cache_index", "shift_index"):
                 return x.at[idx].set(T)
             if key == "shift_hist":
@@ -1030,6 +1287,14 @@ class Engine:
 
         self.cache = jax.tree_util.tree_map_with_path(fn, self.cache)
         if cow:
+            # the map-time COW rides the donated fixed-shape copy jit —
+            # one warm dispatch, not an eager pool-sized rewrite
+            self.cache = _copy_pages_jit(
+                self.cache, *self._padded_copy(
+                    [terminal.page_id], [idx * n_p + len(nodes) - 1],
+                    [terminal.valid],
+                )
+            )
             self.prefix.release([terminal])
             self.counters.inc("serve.prefix.cow_copies")
         slot = _Slot(
@@ -1263,16 +1528,36 @@ class Engine:
             self.prefix.release(protected)
         if not dst:
             return
-
-        def fn(path, x):
-            if getattr(path[-1], "key", None) in (
-                "cached_key_pages", "cached_value_pages"
-            ):
-                return paged_kv.copy_pages(x, src, dst, valids)
-            return x
-
-        self.cache = jax.tree_util.tree_map_with_path(fn, self.cache)
+        # ONE donated fixed-shape dispatch for the whole publish (the
+        # PR 10 follow-on): padded to the engine's copy width so every
+        # publish shares a single compile signature, off the host path
+        self.cache = _copy_pages_jit(
+            self.cache, *self._padded_copy(src, dst, valids)
+        )
         self.counters.inc("serve.prefix.published", len(dst))
+
+    def _padded_copy(self, src, dst, valids, dst_total: Optional[int] = None):
+        """Pad a page-copy request to the engine's fixed copy width
+        (``self._copy_pad`` — a publish copies at most the prompt's
+        pages, a COW/restore fewer) so the donated copy jits
+        (``_copy_pages_jit``/``_copy_pages_across_jit``) compile exactly
+        once per engine. Padding entries carry dst == ``dst_total`` (the
+        scatter's out-of-range drop sentinel;
+        ops/paged_kv.py:copy_pages_across) and valid 0. ``dst_total``
+        defaults to the batched cache's page count."""
+        if dst_total is None:
+            dst_total = (
+                (self.config.max_batch + self._arena_rows)
+                * self.n_pages_slot
+            )
+        P = self._copy_pad
+        assert len(src) <= P, (len(src), P)
+        pad = P - len(src)
+        return (
+            jnp.asarray(list(src) + [0] * pad, jnp.int32),
+            jnp.asarray(list(dst) + [dst_total] * pad, jnp.int32),
+            jnp.asarray(list(valids) + [0] * pad, jnp.int32),
+        )
 
     def _degraded_budget(self, entry: Entry) -> tuple:
         return self._clamped_budget(entry.request.max_new_tokens)
@@ -1380,6 +1665,77 @@ class Engine:
         the fused block computes every row at the fixed iteration width
         (a 1-token tail is just one valid column of a padded row)."""
         return min(self.config.prefill_chunk, self.T - filled)
+
+    def _plan_fused_prefills(self, decode_tokens: int) -> List[Tuple["_Slot", int]]:
+        """One fused iteration's prefill chunk grants, shared by the
+        plain and SPECULATIVE iterations: in-progress prefills served
+        head-of-line by effective priority under the ``TokenBudget``
+        policy after decode's charge (``decode_tokens`` — one token per
+        active slot in plain mode, the summed verify widths in
+        speculative mode). The ``prefill_fail`` fault fires per granted
+        chunk; a retry resumes from the last completed chunk, exhausted
+        attempts finish the request typed."""
+        pre = [
+            s for s in self.slots
+            if s and s.phase == _PREFILL and s.filled < self.T
+        ]
+        pre.sort(key=lambda s: (
+            -self.sched.effective_priority(s.entry), s.admit_seq
+        ))
+        grants = self.budget.plan_iteration(
+            decode_tokens, [self._next_chunk_fused(s.filled) for s in pre]
+        )
+        chunks: List[Tuple[_Slot, int]] = []
+        for slot, take in zip(pre, grants):
+            if not take:
+                continue
+            entry = slot.entry
+            if FAULTS.take("prefill_fail"):
+                self.counters.inc("serve.fault_prefill_fail")
+                entry.prefill_attempts += 1
+                self.counters.inc("serve.prefill_retries")
+                TELEMETRY.event(
+                    "serve.prefill_retry", request_id=entry.request_id,
+                    parent=self._req_spans.get(entry.request_id),
+                    attempt=entry.prefill_attempts, chunk_start=slot.filled,
+                )
+                if entry.prefill_attempts >= self.config.prefill_attempts:
+                    self._release_slot(slot)
+                    self._finish(
+                        entry, Outcome.PREFILL_FAILED, tokens=None,
+                        detail="prefill failed after "
+                               f"{entry.prefill_attempts} attempts "
+                               f"({slot.filled}/{self.T} tokens prefilled)",
+                    )
+                continue  # retry next iteration, from this same chunk
+            chunks.append((slot, self._next_chunk_fused(slot.filled)))
+        return chunks
+
+    def _advance_dispatched_chunks(self, chunks, final, flogits,
+                                   tok_on_device: bool = False) -> None:
+        """Post-dispatch bookkeeping for one fused iteration's prefill
+        chunks, shared by the plain and SPECULATIVE dispatches: advance
+        the fill frontier, slice publish ring seams from the batched
+        cache, and transition final-chunk rows to decode AT DISPATCH —
+        the row's cache is fully written and its first image token is in
+        the in-flight samples, so the next iteration dispatches it as a
+        decode row; the token VALUE lands in ``entry.generated`` at
+        readback. The per-row terminal logits (the prefix cache's
+        full-hit payload) are captured on the warm final class. The
+        plain fused path marks the first sample as riding the device
+        (``tok_on_device`` — the lookahead seam); the speculative path
+        reads it back synchronously the same iteration instead."""
+        for s, c in chunks:
+            s.filled += c
+            self._maybe_snapshot(s, self.cache, s.index)
+            if final[s.index]:
+                if self.prefix is not None and flogits is not None:
+                    s.final_logits = flogits[s.index][None]
+                TELEMETRY.end(s.prefill_span, outcome="completed")
+                s.prefill_span = None
+                s.phase = _DECODE
+                s.pos = self.T
+                s.tok_on_device = tok_on_device
 
     def _advance_prefills(self) -> bool:
         """Run this iteration's budgeted prefill chunks: in-progress
@@ -1560,42 +1916,7 @@ class Engine:
                 continue
         dispatchable = [s for s in dispatchable if self.slots[s.index] is s]
 
-        # prefill chunk grants: one chunk per row, same head-of-line
-        # order and budget policy as the split path, same per-chunk fault
-        pre = [
-            s for s in self.slots
-            if s and s.phase == _PREFILL and s.filled < self.T
-        ]
-        pre.sort(key=lambda s: (
-            -self.sched.effective_priority(s.entry), s.admit_seq
-        ))
-        grants = self.budget.plan_iteration(
-            len(dispatchable), [self._next_chunk_fused(s.filled) for s in pre]
-        )
-        chunks: List[Tuple[_Slot, int]] = []
-        for slot, take in zip(pre, grants):
-            if not take:
-                continue
-            entry = slot.entry
-            if FAULTS.take("prefill_fail"):
-                self.counters.inc("serve.fault_prefill_fail")
-                entry.prefill_attempts += 1
-                self.counters.inc("serve.prefill_retries")
-                TELEMETRY.event(
-                    "serve.prefill_retry", request_id=entry.request_id,
-                    parent=self._req_spans.get(entry.request_id),
-                    attempt=entry.prefill_attempts, chunk_start=slot.filled,
-                )
-                if entry.prefill_attempts >= self.config.prefill_attempts:
-                    self._release_slot(slot)
-                    self._finish(
-                        entry, Outcome.PREFILL_FAILED, tokens=None,
-                        detail="prefill failed after "
-                               f"{entry.prefill_attempts} attempts "
-                               f"({slot.filled}/{self.T} tokens prefilled)",
-                    )
-                continue  # retry next iteration, from this same chunk
-            chunks.append((slot, self._next_chunk_fused(slot.filled)))
+        chunks = self._plan_fused_prefills(len(dispatchable))
 
         worked = False
         with TELEMETRY.span(
@@ -1678,27 +1999,9 @@ class Engine:
         for s in dispatchable:
             s.pos += 1
             s.tok_on_device = True
-        for s, c in chunks:
-            s.filled += c
-            # the row's chunks live in the batched cache — page-boundary
-            # ring seams for publish are sliced from it post-dispatch
-            self._maybe_snapshot(s, self.cache, s.index)
-            if final[s.index]:
-                if self.prefix is not None and flogits is not None:
-                    s.final_logits = flogits[s.index][None]
-                # prefill complete at DISPATCH: the row's cache is fully
-                # written and its first image token is in the in-flight
-                # samples, so the slot transitions to the decode phase
-                # NOW — the next iteration dispatches it as a decode row
-                # whose input rides the pending sample array
-                # (tok_on_device), never visiting the host. The token
-                # VALUE lands in entry.generated at readback
-                # (_finish_prefill_fused).
-                TELEMETRY.end(s.prefill_span, outcome="completed")
-                s.prefill_span = None
-                s.phase = _DECODE
-                s.pos = self.T
-                s.tok_on_device = True
+        self._advance_dispatched_chunks(
+            chunks, final, flogits, tok_on_device=True
+        )
         return samples, entries
 
     def _fused_readback(self, prev) -> None:
@@ -1732,6 +2035,200 @@ class Engine:
         self._record_first_token(entry, self.clock.now())
         if len(entry.generated) >= entry.effective_max_new:
             self._complete(slot)
+
+    # -------------------------------------------------- speculative decode
+
+    def _spec_iteration(self) -> bool:
+        """One SPECULATIVE TokenBudget iteration (ROADMAP 2): the same
+        descriptor assembly as ``_fused_iteration``, except every decode
+        row becomes a VERIFY row of width 1 + min(spec_k, remaining - 1)
+        — up to spec_k self-drafted tokens checked by exact-match
+        acceptance in the single ragged dispatch — and the iteration is
+        SYNCHRONOUS: the sample matrix and per-row accepted counts are
+        read back before the next dispatch is assembled, because the
+        next descriptors must start at the accepted frontier (the
+        rollback is descriptor anchoring; ops/attention.py,
+        ops/layers.py). The readback the lookahead seam used to hide is
+        amortized over up to spec_k+1 committed tokens per row per step;
+        ``decode_lookahead`` is a no-op here and ``self._pending`` stays
+        None (the seam carries its k samples WITHIN the iteration).
+
+        The TokenBudget charges the decode lane the full VERIFY widths
+        (the tokens the dispatch actually computes); progress — request
+        completion, tokens/sec, the accept histograms — is accounted in
+        ACCEPTED tokens (scheduler.TokenBudget docstring).
+
+        The ``spec_verify_abort`` fault (a drafter failure) degrades ONE
+        iteration to plain decode — verify width 1, drafts ignored —
+        through the SAME jit signature, so the fallback can never
+        recompile; output is bit-identical by construction (a width-1
+        verify row IS a plain decode row), and the degradation is
+        counted (``serve.spec.fallbacks``)."""
+        cfg = self.config
+        if FAULTS.take("decode_stall"):
+            self.counters.inc("serve.fault_decode_stall")
+            TELEMETRY.event(
+                "serve.decode_stall", penalty_s=cfg.stall_penalty_s
+            )
+            self.clock.advance(cfg.stall_penalty_s)
+        dispatchable = [
+            s for s in self.slots
+            if s and s.phase == _DECODE
+            and len(s.entry.generated) < s.entry.effective_max_new
+        ]
+        spec_on = True
+        if dispatchable and FAULTS.take("spec_verify_abort"):
+            spec_on = False
+            self.counters.inc("serve.fault_spec_verify_abort")
+            self.counters.inc("serve.spec.fallbacks")
+        widths: Dict[int, int] = {}
+        for s in dispatchable:
+            remaining = s.entry.effective_max_new - len(s.entry.generated)
+            # capping the verify width at the remaining budget keeps the
+            # worst-case page demand identical to plain decode (the last
+            # written position never passes T + max_new - 2)
+            widths[id(s)] = 1 if not spec_on else min(
+                cfg.spec_k + 1, remaining
+            )
+        for slot in sorted(
+            dispatchable,
+            key=lambda s: -self.sched.effective_priority(s.entry),
+        ):
+            if self.slots[slot.index] is not slot:
+                continue
+            # pages covering the whole verify block [0, pos + k - 1],
+            # minus the prefix pages the slot maps shared
+            k_b = widths[id(slot)]
+            needed = (
+                (slot.pos + k_b - 1) // self.page + 1
+                - len(slot.shared_nodes)
+            )
+            deficit = needed - self.pool.held(slot.entry.request_id)
+            if deficit > 0 and not self._alloc_or_preempt(slot, deficit):
+                continue
+        dispatchable = [s for s in dispatchable if self.slots[s.index] is s]
+
+        # decode charged at VERIFY width: a speculative row occupies its
+        # whole block of the iteration's token budget, so prefill grants
+        # shrink exactly as if that many plain decode rows ran
+        chunks = self._plan_fused_prefills(
+            sum(widths[id(s)] for s in dispatchable)
+        )
+
+        if not dispatchable and not chunks:
+            return False
+        drafted = sum(widths[id(s)] - 1 for s in dispatchable)
+        with TELEMETRY.span(
+            "serve.iteration",
+            n_decode=len(dispatchable), n_prefill=len(chunks),
+            lookahead=False, spec=spec_on,
+        ):
+            with TELEMETRY.span(
+                "serve.spec_verify",
+                n_verify=len(dispatchable), drafted=drafted,
+            ):
+                prev = self._dispatch_spec(dispatchable, widths, chunks)
+                self._spec_readback(prev)
+        return True
+
+    def _dispatch_spec(self, verifies: List[_Slot], widths: Dict[int, int],
+                       chunks: List[Tuple[_Slot, int]]):
+        """Dispatch one speculative fused iteration: descriptor assembly
+        only — sampling keys derive in-trace from the per-slot base keys
+        (column j of a verify row uses ``fold_in(key(seed), pos+j+1)``,
+        the SAME key the sequential decode step at that position would
+        use, and the key the in-trace drafter samples d_j with —
+        exact-match acceptance compares like with like). Sync mode:
+        input tokens are always host-scattered (the accepted-last token
+        lives at a data-dependent column of the previous sample
+        matrix)."""
+        B, W = self.config.max_batch, self._W
+        start = np.zeros((B,), np.int32)
+        length = np.zeros((B,), np.int32)
+        final = np.zeros((B,), bool)
+        host_idx: List[int] = []
+        host_tok: List[int] = []
+        entries: List[Tuple[_Slot, str, int]] = []
+        for s in verifies:
+            k_b = widths[id(s)]
+            start[s.index] = s.pos
+            length[s.index] = k_b
+            host_idx.append(s.index)
+            host_tok.append(s.tok)
+            entries.append((s, _DECODE, k_b))
+        for s, c in chunks:
+            self.counters.inc("serve.prefill_chunks")
+            start[s.index] = s.filled
+            length[s.index] = c
+            if s.filled + c >= self.T:
+                final[s.index] = True
+                entries.append((s, _PREFILL, c))
+        if verifies:
+            self.counters.inc("serve.decode_steps")
+        # the token scatter rides a FIXED padded shape (index vector
+        # padded to B with an out-of-range drop sentinel): a speculative
+        # trace mixes every (verify-width, final-chunk) combination, and
+        # an un-padded scatter would compile one tiny module per distinct
+        # row count — in-trace compiles the zero-compile contract
+        # forbids. Sampling keys are derived entirely IN-TRACE from
+        # self._base_keys (written at admission), no per-iteration key
+        # assembly at all.
+        tok = self._zero_tok
+        if host_idx:
+            pad = B - len(host_idx)
+            tok = tok.at[jnp.asarray(host_idx + [B] * pad)].set(
+                jnp.asarray(host_tok + [0] * pad, jnp.int32), mode="drop"
+            )
+        self.dispatches += 1
+        self.counters.inc("serve.dispatches")
+        self.cache, samples, accepted, flogits = _spec_iteration_jit(
+            self.dalle, self.params, self.cache, self._prompts,
+            tok, jnp.asarray(start), jnp.asarray(length), jnp.asarray(final),
+            self._base_keys, W, self.k_img, self.config.temperature,
+            bool(final.any()), self.config.spec_k,
+            self.config.spec_draft_depth,
+        )
+        self._advance_dispatched_chunks(chunks, final, flogits)
+        return samples, accepted, entries
+
+    def _spec_readback(self, prev) -> None:
+        """Apply one speculative iteration's host decisions: commit each
+        verify row's accepted prefix (1..k tokens, bit-identical to what
+        sequential decode would have produced — exact-match acceptance),
+        advance the host position to the accepted frontier (the next
+        dispatch's descriptors realize the rewind), land final-chunk
+        first tokens, and tally the draft/accept accounting."""
+        samples, accepted, entries = prev
+        samples = np.asarray(samples)
+        accepted = np.asarray(accepted)
+        for s, kind, k_b in entries:
+            if self.slots[s.index] is not s:
+                continue  # terminated/evicted by the termination sweep
+            if kind == _DECODE:
+                acc = int(accepted[s.index])
+                assert 1 <= acc <= k_b, (
+                    f"accepted count {acc} outside verify width "
+                    f"[1, {k_b}] — the acceptance scan is corrupt"
+                )
+                toks = [int(t) for t in samples[s.index, :acc]]
+                s.entry.generated.extend(toks)
+                s.tok = toks[-1]
+                s.pos += acc
+                n_drafted = k_b - 1
+                self._spec_drafted += n_drafted
+                self._spec_accepted += acc - 1
+                self.counters.inc("serve.spec.drafted", n_drafted)
+                self.counters.inc("serve.spec.accepted", acc - 1)
+                self.counters.inc(
+                    "serve.spec.rejected", n_drafted - (acc - 1)
+                )
+                self.histograms.observe(
+                    "serve.spec_accepted_per_step", float(acc)
+                )
+                if len(s.entry.generated) >= s.entry.effective_max_new:
+                    self._complete(s)
+            else:
+                self._finish_prefill_fused(s, int(samples[s.index, k_b - 1]))
 
     def _record_first_token(self, entry: Entry, now: float) -> None:
         """TTFT bookkeeping: set once per request (a preempted request's
@@ -2153,6 +2650,12 @@ class Engine:
             sum(bool(s) and s.phase == _PREFILL for s in self.slots),
         )
         self.gauges.set("serve.queued", len(self.sched))
+        if self.spec:
+            self.gauges.set(
+                "serve.spec_accept_frac",
+                self._spec_accepted / self._spec_drafted
+                if self._spec_drafted else 0.0,
+            )
         if self.prefix is not None:
             probes = self._prefix_hits + self._prefix_misses
             self.gauges.set(

@@ -86,7 +86,8 @@ class TokenBudget:
                 granted_any = True
         return grants
 
-    def plan_iteration(self, n_decode: int, next_chunks: Sequence[int]) -> List[bool]:
+    def plan_iteration(self, decode_tokens: int,
+                       next_chunks: Sequence[int]) -> List[bool]:
         """Which in-progress prefills run their next chunk in a FUSED
         iteration (serving/engine.py:_iteration_jit).
 
@@ -95,8 +96,16 @@ class TokenBudget:
         ragged dispatch — but runs every granted chunk in the SAME
         dispatch instead of the split path's sequential head-of-line
         chunk jits. ``next_chunks``: width of each prefill's next chunk,
-        in scheduling order. Decode is charged first (one token per
-        active slot, exactly like ``plan``); the head prefill keeps the
+        in scheduling order. Decode is charged first, as
+        ``decode_tokens`` — one token per active slot in plain mode; a
+        SPECULATIVE iteration charges each decode row its whole verify
+        width (1 + drafted tokens: the tokens the dispatch genuinely
+        computes, so prefill grants shrink exactly as if that many plain
+        decode rows ran). The budget meters device WORK; request
+        progress — completion against max_new_tokens, tokens/sec, the
+        bench histograms — is accounted in ACCEPTED tokens, which is why
+        a speculative engine can commit up to spec_k+1 tokens from one
+        budget-charged block. The head prefill keeps the
         forward-progress floor (granted even when decode exhausted the
         budget); granting stops at the FIRST chunk that does not fit —
         strict head-of-line, like ``plan``: letting a smaller
@@ -107,7 +116,7 @@ class TokenBudget:
             return take
         if self.budget is None:
             return [True] * len(next_chunks)
-        left = self.budget - n_decode
+        left = self.budget - decode_tokens
         for i, c in enumerate(next_chunks):
             if i > 0 and left < c:
                 break

@@ -60,6 +60,13 @@ Sites in use:
                  request's prompt pages into the prefix index fails —
                  fail-open by contract: the request still completes
                  normally and its pages stay private (freed, unindexed)
+``spec_verify_abort`` ``serving.engine``: the speculative drafter fails
+                 for one iteration — the engine degrades that iteration
+                 to PLAIN decode (verify width 1, no drafts consumed)
+                 through the same jit signature; output is bit-identical
+                 by construction (exact acceptance makes a width-1
+                 verify row a plain decode row) and the fallback is
+                 counted (``serve.spec.fallbacks``)
 ===============  =============================================================
 
 Injection must be impossible to leave on by accident: the registry is
@@ -88,6 +95,7 @@ KNOWN_SITES = frozenset({
     "telemetry_sink_fail",
     "replica_crash", "replica_stall", "health_flap",
     "prefix_hash_collide", "prefix_publish_fail",
+    "spec_verify_abort",
 })
 
 

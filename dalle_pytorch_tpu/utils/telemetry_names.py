@@ -39,6 +39,8 @@ SPANS = frozenset({
     "serve.slot_insert",
     "serve.decode_step",    # one per DISPATCHED decode step (split mode)
     "serve.iteration",      # one per fused ragged iteration (one dispatch)
+    "serve.spec_verify",    # one per speculative iteration: draft+verify+
+                            # accept dispatch and its synchronous readback
     # replicated front door (serving/router.py)
     "router.request",       # router submit -> typed outcome
     # trainer (train_dalle.py)
@@ -106,6 +108,12 @@ COUNTERS = frozenset({
     "serve.fault_page_exhaust",
     "serve.fault_prefix_hash_collide",
     "serve.fault_prefix_publish_fail",
+    "serve.fault_spec_verify_abort",
+    # speculative decoding (serving/engine.py:_spec_iteration)
+    "serve.spec.drafted",     # draft tokens proposed to verify rows
+    "serve.spec.accepted",    # drafts committed by exact-match acceptance
+    "serve.spec.rejected",    # drafts discarded (rolled back)
+    "serve.spec.fallbacks",   # iterations degraded to plain decode
     # cross-request prefix cache (serving/prefix_cache.py)
     "serve.prefix.hits",          # probes matching >=1 page
     "serve.prefix.misses",        # probes matching nothing
@@ -160,6 +168,7 @@ GAUGES = frozenset({
     "serve.queued",
     "serve.prefix_hit_frac",     # hits / (hits + misses), lifetime
     "serve.prefix_pages",        # pages currently held by the index
+    "serve.spec_accept_frac",    # accepted / drafted, lifetime
     "router.queued",
     "router.fleet_occupancy",
     "router.replicas_live",
@@ -179,6 +188,9 @@ HISTOGRAMS = frozenset({
     "serve.ttft_full_hit_s",
     "serve.ttft_partial_hit_s",
     "serve.ttft_cold_s",
+    # tokens committed per speculative verify step (1 .. spec_k+1); the
+    # bench's accepted-tokens-per-step distribution reads this
+    "serve.spec_accepted_per_step",
 })
 
 # span durations are auto-observed as "<span>_s" (utils/telemetry.py);

@@ -572,12 +572,13 @@ def test_telemetry_smoke_gate(tmp_path):
     summary = json.loads(
         [l for l in out.stdout.splitlines() if l.startswith('{"flight_file')][0]
     )
-    # 3 chunked + 3 monolithic + 3 fused + 6 prefix-cache cold/warm
-    # completions, 1 mid-prefill deadline drill — the warm round's
-    # full-hit requests (no prefill span at all) must still close their
-    # serve.request chains typed
+    # 3 chunked + 3 monolithic + 3 fused + 3 speculative + 6
+    # prefix-cache cold/warm completions, 1 mid-prefill deadline drill —
+    # the warm round's full-hit requests (no prefill span at all) must
+    # still close their serve.request chains typed
     assert summary["request_outcomes"] == {
-        "completed": 15, "deadline_exceeded": 1,
+        "completed": 18, "deadline_exceeded": 1,
     }
     assert summary["prefill_chunk_spans"] >= 2
+    assert summary["spec_verify_spans"] >= 1
     assert summary["interference_max_gap_ms"] > 0

@@ -130,6 +130,45 @@ def build_entry_points() -> List[EntryPoint]:
         params, cache1, internal, key,
     )[2]
 
+    # the speculative fused engine (ROADMAP 2): the SAME checkpoint with
+    # the token-shift ring widened by spec_k rows (the rollback slack) and
+    # the block width stretched to carry a full verify row — both derived
+    # through the engine's OWN helpers (spec_model / fused_width) so the
+    # committed contract tracks the code, not a transcription of it
+    from dalle_pytorch_tpu.serving.engine import fused_width, spec_model
+
+    cfg_spec = EngineConfig(
+        **CANON_ENGINE, fused_iteration=True, spec_decode=True,
+    )
+    dalle_spec = spec_model(dalle, cfg_spec.spec_k)
+    W_spec = fused_width(cfg_spec)
+
+    def cache_avals_for(model, b):
+        def build(p):
+            return set_decode_offsets(
+                init_decode_cache(model, p, b, cache_format="paged"),
+                jnp.zeros((b,), jnp.int32),
+            )
+        return jax.eval_shape(build, params)
+
+    cacheB_spec = cache_avals_for(dalle_spec, B)
+    # spec + prefix-cache composition: arena rows appended to the
+    # ring-widened batched pools — page counts are seq-len-derived, so
+    # the arena sizing is identical to the plain prefix engine's
+    cacheB_spec_arena = jax.eval_shape(
+        lambda c: _append_arena_rows(c, arena_rows), cacheB_spec
+    )
+    # per-slot BASE sampling keys (Engine._base_keys): the spec jit
+    # derives the whole (B, W) key matrix from these in-trace
+    keysB_base = jax.eval_shape(
+        lambda: jnp.stack([jax.random.key(0)] * B)
+    )
+    # the donated fixed-shape page-copy jits (the PR 10 follow-on): call
+    # vectors pad to the engine's copy width — at most one prompt's pages
+    # (Engine.__init__: self._copy_pad)
+    copy_pad = pages_for(T, page)
+    copy_vec = SDS((copy_pad,), jnp.int32)
+
     # chunk widths exactly as the engine schedules them: simulate the
     # REAL Engine._next_chunk (1-token tails merged) over (T, chunk)
     shim = SimpleNamespace(config=cfg, T=T)
@@ -296,6 +335,119 @@ def build_entry_points() -> List[EntryPoint]:
             # dispatches before entering decode
             signatures=[Signature(
                 "hit", (logits1, key, k_img, 1.0),
+            )],
+        ),
+        EntryPoint(
+            name="serving.iteration_spec",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_spec_iteration_jit",
+            fn=eng._spec_iteration_jit,
+            lower=eng._spec_iteration_jit.lower,
+            static_argnums=(0, 9, 10, 12, 13, 14),
+            donate={"cache": 2},
+            # the speculative fused iteration (ROADMAP 2): draft, verify,
+            # and accept in ONE dispatch over the ring-widened model.
+            # Descriptor raggedness (verify widths 1..spec_k+1, chunk
+            # mixes, the spec_verify_abort plain-decode fallback) is all
+            # DATA, so the steady state is EXACTLY the "steady" signature
+            # plus the warm "final" class (any_final) — the same
+            # two-signature budget as serving.iteration; a third
+            # signature is the shape-drift-recompile bug class
+            signatures=[
+                Signature(
+                    "steady",
+                    (dalle_spec, params, cacheB_spec,
+                     SDS((B, T), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.bool_), keysB_base, W_spec, k_img,
+                     1.0, False, cfg_spec.spec_k,
+                     cfg_spec.spec_draft_depth),
+                ),
+                Signature(
+                    "final",
+                    (dalle_spec, params, cacheB_spec,
+                     SDS((B, T), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.bool_), keysB_base, W_spec, k_img,
+                     1.0, True, cfg_spec.spec_k,
+                     cfg_spec.spec_draft_depth),
+                ),
+            ],
+        ),
+        EntryPoint(
+            name="serving.iteration_spec_prefix",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_spec_iteration_jit",
+            fn=eng._spec_iteration_jit,
+            lower=eng._spec_iteration_jit.lower,
+            static_argnums=(0, 9, 10, 12, 13, 14),
+            donate={"cache": 2},
+            # the spec engine with the prefix cache on: the SAME program
+            # over the arena-extended, ring-widened cache — the same
+            # two-signature budget (the serving.iteration_prefix pattern)
+            signatures=[
+                Signature(
+                    "steady_arena",
+                    (dalle_spec, params, cacheB_spec_arena,
+                     SDS((B, T), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.bool_), keysB_base, W_spec, k_img,
+                     1.0, False, cfg_spec.spec_k,
+                     cfg_spec.spec_draft_depth),
+                ),
+                Signature(
+                    "final_arena",
+                    (dalle_spec, params, cacheB_spec_arena,
+                     SDS((B, T), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.bool_), keysB_base, W_spec, k_img,
+                     1.0, True, cfg_spec.spec_k,
+                     cfg_spec.spec_draft_depth),
+                ),
+            ],
+        ),
+        EntryPoint(
+            name="serving.page_copy",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_copy_pages_jit",
+            fn=eng._copy_pages_jit,
+            lower=eng._copy_pages_jit.lower,
+            static_argnums=(),
+            donate={"cache": 0},
+            # the donated fixed-shape publish/COW page copy (the PR 10
+            # follow-on): every call pads its src/dst/valid vectors to
+            # the engine's copy width, so ONE signature per cache tree
+            # covers publish, map-time COW, and every partial batch —
+            # the eager pool-sized .at[].set rewrites this retired
+            # stayed on the host path and re-traced per shape. The
+            # speculative prefix engine publishes through the same jit
+            # over the ring-widened arena tree: its one extra signature
+            # is contracted here (the serving.iteration_spec_prefix
+            # composition)
+            signatures=[
+                Signature(
+                    "publish", (cacheB_arena, copy_vec, copy_vec, copy_vec),
+                ),
+                Signature(
+                    "publish_spec",
+                    (cacheB_spec_arena, copy_vec, copy_vec, copy_vec),
+                ),
+            ],
+        ),
+        EntryPoint(
+            name="serving.page_copy_across",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_copy_pages_across_jit",
+            fn=eng._copy_pages_across_jit,
+            lower=eng._copy_pages_across_jit.lower,
+            static_argnums=(),
+            donate={"dst_cache": 0},
+            # the split engine's partial-hit restore: arena pages out of
+            # the batched pools into a private batch-1 prefill cache,
+            # destination donated, same padded shape
+            signatures=[Signature(
+                "restore",
+                (cache1, cacheB_arena, copy_vec, copy_vec, copy_vec),
             )],
         ),
         _train_entry(dalle, B),

@@ -2,13 +2,16 @@
 """Serving-engine release gate: continuous-batching passes on CPU.
 
 Builds a tiny DALLE in-process (no checkpoint needed) and drives the full
-engine lifecycle four times — CHUNKED prefill (budget-bounded prompt
+engine lifecycle five times — CHUNKED prefill (budget-bounded prompt
 chunks interleaved with decode; the production serving shape),
 monolithic, FUSED (the whole iteration as one ragged ``_iteration_jit``
-dispatch; ROADMAP 1), and a PREFIX-CACHE cold/warm replay (ROADMAP 3:
-the same 3-request scenario twice through one engine with the
-content-addressed page index on; the warm round must hit and match the
-cold round bitwise) — verifying the accounting invariant each time:
+dispatch; ROADMAP 1), SPECULATIVE (ROADMAP 2: each decode row
+self-drafts and the single ragged dispatch verifies — exact acceptance
+makes the stream bit-identical to plain decode by construction), and a
+PREFIX-CACHE cold/warm replay (ROADMAP 3: the same 3-request scenario
+twice through one engine with the content-addressed page index on; the
+warm round must hit and match the cold round bitwise) — verifying the
+accounting invariant each time:
 every request ends in a typed outcome, all pages return to the pool
 (the prefix pass additionally checks refcount accounting — references
 == mapped table entries, no leaks after drain), and all modes produce
@@ -219,6 +222,16 @@ def main(argv=None) -> int:
     # retry first, but composes with DALLE_TPU_FAULTS the same way
     # (chunk-granular prefill_fail with resume-from-last-chunk)
     fused = run_pass("fused", prefill_chunk=2, fused_iteration=True)
+    # speculative pass (ROADMAP 2): every decode row self-drafts spec_k
+    # tokens and the single ragged dispatch VERIFIES them; exact
+    # acceptance makes the stream bit-identical to all the passes above
+    # by construction — asserted below. Composes with DALLE_TPU_FAULTS:
+    # an armed ``spec_verify_abort`` degrades one iteration to plain
+    # decode (same signature, tokens unchanged)::
+    #
+    #     DALLE_TPU_FAULTS="spec_verify_abort=1" python tools/serve_smoke.py
+    spec = run_pass("spec", prefill_chunk=2, fused_iteration=True,
+                    spec_decode=True, spec_k=2)
 
     # prefix-cache cold/warm replay (ROADMAP 3): ONE engine with the
     # content-addressed page index runs the SAME 3-request scenario
@@ -288,6 +301,7 @@ def main(argv=None) -> int:
         ok = ok and mono[rid].outcome is Outcome.COMPLETED
         ok = ok and chunked[rid].outcome is Outcome.COMPLETED
         ok = ok and fused[rid].outcome is Outcome.COMPLETED
+        ok = ok and spec[rid].outcome is Outcome.COMPLETED
         if not np.array_equal(
             np.asarray(mono[rid].tokens), np.asarray(chunked[rid].tokens)
         ):
@@ -300,6 +314,13 @@ def main(argv=None) -> int:
             ok = False
             print(f"serve smoke FAILED: {rid} fused tokens diverge from "
                   "the split path", file=sys.stderr)
+        if not np.array_equal(
+            np.asarray(mono[rid].tokens), np.asarray(spec[rid].tokens)
+        ):
+            ok = False
+            print(f"serve smoke FAILED: {rid} speculative tokens diverge "
+                  "from plain decode — the exact-acceptance contract is "
+                  "broken", file=sys.stderr)
 
     # mid-prefill deadline drill: token_budget=1 throttles prefill to one
     # chunk per iteration (the forward-progress floor), the FakeClock makes
@@ -335,8 +356,9 @@ def main(argv=None) -> int:
     if not ok:
         print("serve smoke FAILED: not every request completed", file=sys.stderr)
         return 1
-    print("serve smoke OK: 3/3 completed chunked, monolithic, fused AND "
-          "the prefix-cache cold/warm replay (bit-identical, warm round "
+    print("serve smoke OK: 3/3 completed chunked, monolithic, fused, "
+          "SPECULATIVE (exact-acceptance bit-parity) AND the prefix-cache "
+          "cold/warm replay (bit-identical, warm round "
           "hit the index), mid-prefill deadline drill typed, pool drained"
           + (f", {2 * n_replicas}/{2 * n_replicas} completed the "
              f"{n_replicas}-replica crash drill bit-identically"

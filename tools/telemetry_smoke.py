@@ -14,7 +14,11 @@ release needs (docs/DESIGN.md §9):
    including the CHUNKED-prefill pass, whose ``serve.prefill_chunk``
    spans and ``serve.ttft_s`` histogram must be present, and the
    prefix-cache cold/warm replay, whose warm full-hit requests open no
-   prefill span at all yet must still close their chains typed;
+   prefill span at all yet must still close their chains typed, and the
+   SPECULATIVE pass, whose per-iteration ``serve.spec_verify`` spans
+   (draft+verify+accept dispatch plus synchronous readback) must appear
+   balanced with the ``serve_spec_*`` counter series rendering in
+   ``/metrics``;
 3. the ``/metrics`` exposition renders (every sample line parses as
    ``name{...} value``);
 4. the long-prompt-arrival-during-steady-decode interference scenario
@@ -139,10 +143,26 @@ def main(argv=None) -> int:
     check(histograms.get("serve.ttft_s") is not None,
           "serve.ttft_s histogram missing after the serving passes")
 
+    # speculative-pass observability (ISSUE 11): every speculative
+    # iteration opened one serve.spec_verify span (validate_flight_file
+    # already proved balance above), and the draft/accept accounting
+    # rendered as counter series + the accepted-per-step histogram
+    n_spec_spans = summary["by_name"].get("serve.spec_verify", 0) // 2
+    check(n_spec_spans >= 1,
+          f"expected >=1 serve.spec_verify spans from the speculative "
+          f"pass, saw {n_spec_spans}")
+    check(histograms.get("serve.spec_accepted_per_step") is not None,
+          "serve.spec_accepted_per_step histogram missing after the "
+          "speculative pass")
+
     # -- 3. the exposition renders ----------------------------------------
     dump = TELEMETRY.dump()
     check("serve_submitted" in dump and "_bucket{" in dump,
           "dump() is missing serving counters or histogram buckets")
+    for series in ("serve_spec_drafted", "serve_spec_accepted",
+                   "serve_spec_accept_frac"):
+        check(series in dump,
+              f"speculative series {series!r} missing from /metrics")
     for line in dump.splitlines():
         if not line or line.startswith("#"):
             continue
@@ -223,6 +243,7 @@ def main(argv=None) -> int:
         "request_outcomes": outcomes,
         "by_name": summary["by_name"],
         "prefill_chunk_spans": n_chunk_spans,
+        "spec_verify_spans": n_spec_spans,
         "interference_max_gap_ms": interference["value"],
         "interference_monolithic_max_gap_ms":
             interference["monolithic_max_gap_ms"],
