@@ -4,6 +4,13 @@ See engine.py for the single-replica architecture, router.py for the
 fleet coordinator, and docs/DESIGN.md for the failure models."""
 
 from .engine import Engine, EngineConfig, check_accounting
+from .journal import (
+    JournalCorrupt,
+    RequestJournal,
+    replay_unfinished,
+    request_from_record,
+    request_to_record,
+)
 from .router import ReplicaState, Router, RouterConfig
 from .scheduler import PagePool, Scheduler, TokenBudget, pages_for
 from .types import (
@@ -22,11 +29,13 @@ __all__ = [
     "EngineConfig",
     "EngineUnsupportedModel",
     "FakeClock",
+    "JournalCorrupt",
     "Outcome",
     "PagePool",
     "RejectReason",
     "ReplicaState",
     "Request",
+    "RequestJournal",
     "RequestResult",
     "Router",
     "RouterConfig",
@@ -34,4 +43,7 @@ __all__ = [
     "TokenBudget",
     "check_accounting",
     "pages_for",
+    "replay_unfinished",
+    "request_from_record",
+    "request_to_record",
 ]

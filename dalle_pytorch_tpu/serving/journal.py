@@ -1,0 +1,317 @@
+"""Durable request journal: an append-only JSONL write-ahead log of
+admitted request descriptors, so a full-process crash replays unfinished
+requests bit-identically on restart.
+
+The serving fleet already survives *replica* death (serving/router.py:
+in-flight work fails over to siblings and replays bit-identically by the
+``(seed, position)`` sampling contract). What it did not survive is
+*process* death: every queued and in-flight request simply vanished.
+This module closes that gap with the same discipline the training side
+uses for checkpoints (utils/resilience.py): every request the router
+admits past its typed-reject gates is appended here as one JSON record
+— request_id, prompt tokens, max_new_tokens, priority, seed, deadline:
+exactly the fields that make replay bit-identical, because tokens depend
+only on ``fold_in(key(seed), position)`` and never on wall-clock or
+batch composition — and every terminal outcome is appended as a
+completion record that makes replay IDEMPOTENT: on restart,
+``unfinished()`` returns the admitted descriptors with no outcome
+record, and resubmitting exactly those neither re-runs finished work
+nor drops unfinished work.
+
+Failure model (docs/DESIGN.md §8.3):
+
+* **Torn tail** — a crash mid-append leaves a final record that is
+  truncated (no trailing newline, or unparseable JSON). That is the
+  ONLY corruption an append-only log can legally contain, so the loader
+  detects it, DROPS it, and counts it (``serve.journal.torn``; the
+  ``journal_torn`` fault site truncates the tail in-memory so the path
+  is drillable on CPU). The dropped request was never acknowledged
+  durable — the client-retry contract, same as a request shed at the
+  door.
+* **Mid-file corruption** — an unparseable record *before* the tail
+  cannot come from a crash (appends are sequential); it is bit rot, and
+  the loader raises the typed ``JournalCorrupt`` rather than guessing
+  (``tools/verify_ckpt.py --serving`` maps it to exit 2).
+* **Graceful shutdown** — ``seal()`` flushes and writes the sidecar
+  file manifest (``utils/resilience.py:write_file_manifest``), the
+  single-file analog of the checkpoint two-phase commit; ``verify()``
+  checks it. A crash leaves no manifest — the loader still recovers via
+  the torn-tail scan; the manifest's job is to let an operator (or the
+  SIGTERM drain path) distinguish "cleanly sealed" from "recovered".
+
+Pure host-side, no jax import — unit-testable like the scheduler.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.faults import FAULTS
+from ..utils.metrics import counters
+from ..utils.resilience import (
+    FILE_MANIFEST_SUFFIX,
+    verify_file_manifest,
+    write_file_manifest,
+)
+from .types import Request
+
+_ADMITTED = "admitted"
+_OUTCOME = "outcome"
+_KINDS = (_ADMITTED, _OUTCOME)
+
+
+class JournalCorrupt(RuntimeError):
+    """A non-tail journal record failed to parse — bit rot, not a torn
+    append. Loaders must not guess past it."""
+
+
+def request_to_record(request: Request, now: float) -> dict:
+    """The JSON-able restorable descriptor of one request: every field
+    replay needs to be bit-identical, nothing else. The deadline is
+    stored BOTH absolute (same-clock restarts, debugging) and as the
+    REMAINING budget at admission — an absolute instant on one
+    process's monotonic clock is meaningless on the next process's, so
+    replay rebases the remaining budget onto the new clock
+    (``request_from_record(now=...)``)."""
+    return {
+        "kind": _ADMITTED,
+        "request_id": request.request_id,
+        "prompt": [int(t) for t in np.asarray(request.prompt).reshape(-1)],
+        "max_new_tokens": int(request.max_new_tokens),
+        "deadline": (
+            None if request.deadline is None else float(request.deadline)
+        ),
+        "deadline_remaining": (
+            None if request.deadline is None
+            else max(0.0, float(request.deadline) - float(now))
+        ),
+        "priority": int(request.priority),
+        "seed": int(request.seed),
+        "t": float(now),
+    }
+
+
+def request_from_record(rec: dict, now: Optional[float] = None) -> Request:
+    """Rebuild a journaled request. With ``now`` (the RESTARTED
+    process's clock), a journaled deadline is rebased: the remaining
+    budget recorded at admission starts over from ``now`` — the old
+    absolute instant lives on another incarnation's clock epoch.
+    Without ``now`` the absolute value is used verbatim (same-process
+    restart, tests)."""
+    deadline = rec.get("deadline")
+    if now is not None and deadline is not None:
+        remaining = rec.get("deadline_remaining")
+        deadline = None if remaining is None else float(now) + remaining
+    return Request(
+        request_id=rec["request_id"],
+        prompt=np.asarray(rec["prompt"], np.int32),
+        max_new_tokens=int(rec["max_new_tokens"]),
+        deadline=deadline,
+        priority=int(rec.get("priority", 0)),
+        seed=int(rec.get("seed", 0)),
+    )
+
+
+class RequestJournal:
+    """See module docstring. One file, one writer (the router holds its
+    lock around every append), any number of post-crash readers."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = str(path)
+        self._fsync = fsync
+        self._fh = None
+
+    # ------------------------------------------------------------ writes
+
+    def _append(self, rec: dict) -> None:
+        if self._fh is None:
+            p = Path(self.path)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            # reopening a sealed journal makes its manifest stale — drop
+            # it so the journal reads as live/unsealed again (seal()
+            # rewrites it at the next graceful shutdown)
+            stale = Path(self.path + FILE_MANIFEST_SUFFIX)
+            if stale.exists():
+                stale.unlink()
+            self._fh = open(self.path, "a", encoding="utf-8")
+        self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        # flush every record: the WAL's whole point is surviving the
+        # process; fsync (surviving the HOST) is opt-in because it turns
+        # every admission into a disk round trip
+        self._fh.flush()
+        if self._fsync:
+            os.fsync(self._fh.fileno())
+
+    def append_admitted(self, request: Request, now: float) -> None:
+        """Record one admission — called AFTER every typed-reject gate
+        passed, so the journal holds exactly the requests the fleet owes
+        a terminal outcome."""
+        self._append(request_to_record(request, now))
+        counters.inc("serve.journal.appended")
+
+    def append_outcome(self, request_id: str, outcome: str,
+                       now: float) -> None:
+        """Record one terminal outcome — what makes replay idempotent."""
+        self._append({
+            "kind": _OUTCOME, "request_id": request_id,
+            "outcome": outcome, "t": float(now),
+        })
+
+    def seal(self) -> None:
+        """Graceful-shutdown flush: close the handle and write the
+        sidecar manifest (two-phase: the artifact is complete before the
+        manifest names it). Safe to call with nothing ever appended."""
+        if self._fh is not None:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+            self._fh = None
+        if Path(self.path).exists():
+            write_file_manifest(self.path)
+
+    def close(self) -> None:
+        """Drop the handle WITHOUT sealing — the crash-simulation seam
+        (tests/chaos): the file is exactly what a dead process left."""
+        if self._fh is not None:
+            self._fh.flush()
+            self._fh.close()
+            self._fh = None
+
+    # ------------------------------------------------------------- reads
+
+    @classmethod
+    def load(cls, path: str, count: bool = True) -> Tuple[List[dict], int]:
+        """Parse the journal -> (records, torn_tail_count).
+
+        The ``journal_torn`` fault truncates the tail record in-memory
+        (the crash-mid-append shape) before parsing. A trailing segment
+        that fails to parse — or lacks its newline — is the torn tail:
+        dropped and, when ``count`` is set, counted
+        (``serve.journal.torn``). An unparseable record anywhere
+        EARLIER is ``JournalCorrupt``. ``count=False`` is for
+        SECONDARY reads (verification, outcome reconciliation): one
+        real torn tail must move the counter — and consume the armed
+        drill — exactly once per recovery, at the replay read, no
+        matter how many times the file is re-parsed."""
+        p = Path(path)
+        if not p.exists():
+            return [], 0
+        data = p.read_text(encoding="utf-8")
+        if data and count and FAULTS.take("journal_torn"):
+            counters.inc("serve.fault_journal_torn")
+            # tear mid-record: drop the trailing newline plus a few bytes
+            data = data[: max(0, len(data) - 5)]
+        segments = data.split("\n")
+        complete, tail = segments[:-1], segments[-1]
+        records: List[dict] = []
+        torn = 0
+        for i, line in enumerate(complete):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                if not isinstance(rec, dict) or rec.get("kind") not in _KINDS:
+                    raise ValueError(f"not a known journal record: {line[:60]!r}")
+            except ValueError as e:
+                if i == len(complete) - 1 and not tail:
+                    torn += 1  # last complete-looking line, torn content
+                    break
+                raise JournalCorrupt(
+                    f"{path}: unparseable non-tail record at line "
+                    f"{i + 1}: {e}"
+                ) from e
+            records.append(rec)
+        if tail.strip():
+            # bytes past the last newline: a torn append by definition
+            torn += 1
+        if torn and count:
+            counters.inc("serve.journal.torn", torn)
+        return records, torn
+
+    @classmethod
+    def unfinished(cls, path: str, now: Optional[float] = None,
+                   count: bool = True) -> List[Request]:
+        """The replay set: admitted descriptors with no outcome record,
+        in admission order (re-admitted duplicates collapse onto the
+        first record — replay resubmission re-appends them). ``now``
+        rebases journaled deadlines onto the restarted process's clock
+        (see ``request_from_record``). This is THE recovery read, so it
+        counts torn tails by default; pass ``count=False`` from
+        inspection tools."""
+        records, _ = cls.load(path, count=count)
+        admitted: Dict[str, dict] = {}
+        done: set = set()
+        for rec in records:
+            if rec["kind"] == _ADMITTED:
+                admitted.setdefault(rec["request_id"], rec)
+            else:
+                done.add(rec["request_id"])
+        return [
+            request_from_record(rec, now=now)
+            for rid, rec in admitted.items()
+            if rid not in done
+        ]
+
+    @classmethod
+    def outcomes(cls, path: str) -> Dict[str, str]:
+        """request_id -> outcome for every journaled terminal record.
+        A secondary read: never counts torn tails (the replay read
+        does)."""
+        records, _ = cls.load(path, count=False)
+        return {
+            rec["request_id"]: rec["outcome"]
+            for rec in records if rec["kind"] == _OUTCOME
+        }
+
+    @classmethod
+    def verify(cls, path: str) -> Tuple[bool, str]:
+        """Operator verification (tools/verify_ckpt.py --serving):
+        sidecar manifest (sealed journals) plus a full parse scan. A
+        recovered-but-unsealed journal verifies iff the scan is clean
+        ("no manifest" is reported but not fatal — a crash legally
+        leaves no manifest)."""
+        ok, reason = verify_file_manifest(path)
+        if not ok and reason != "no manifest":
+            return False, reason
+        try:
+            _, torn = cls.load(path, count=False)
+        except JournalCorrupt as e:
+            return False, str(e)
+        if torn:
+            return True, f"ok ({torn} torn tail record dropped)"
+        if not ok:
+            return True, "ok (unsealed: no manifest — crash recovery)"
+        return True, "ok"
+
+
+def replay_unfinished(path: str, submit: Callable[[Request], object],
+                      reconcile: Optional[Callable[[str, str], None]] = None,
+                      now: Optional[float] = None) -> List[str]:
+    """Resubmit every unfinished journaled request through ``submit``
+    (typically ``Router.submit`` on the restarted process), counting
+    each under ``serve.journal.replayed``; returns the ids that were
+    genuinely re-admitted. A resubmission ``submit`` rejects TYPED
+    (non-None return — e.g. queue_full during a large replay burst) is
+    NOT counted replayed: its typed result is already in the router's
+    results (and journaled as the outcome), so the caller sees the
+    reject rather than a silent drop. ``now`` rebases journaled
+    deadlines onto the restarted clock; ``reconcile(request_id,
+    outcome)`` — optional — receives every ALREADY-finished journaled
+    outcome so a restart harness can hand clients their pre-crash
+    results without re-running them (the idempotency half of the
+    contract)."""
+    if reconcile is not None:
+        for rid, outcome in RequestJournal.outcomes(path).items():
+            reconcile(rid, outcome)
+    replayed: List[str] = []
+    for request in RequestJournal.unfinished(path, now=now):
+        if submit(request) is not None:
+            continue  # typed reject: delivered via results, not replayed
+        counters.inc("serve.journal.replayed")
+        replayed.append(request.request_id)
+    return replayed

@@ -47,7 +47,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -69,6 +69,93 @@ def _digest(parent: bytes, block: np.ndarray) -> bytes:
     return hashlib.sha1(
         parent + np.asarray(block, np.int64).tobytes()
     ).digest()
+
+
+def chain_digest(parent: Optional[bytes], block: np.ndarray) -> bytes:
+    """Public chain-digest derivation (``parent=None`` = chain root) —
+    shared by the index itself and the snapshot verifier, so a persisted
+    node's address can be recomputed from its tokens and checked against
+    what was stored (verify-on-load is mandatory: the hash is an
+    address, never a proof; docs/DESIGN.md §8.3)."""
+    return _digest(_ROOT if parent is None else parent, block)
+
+
+def snapshot_records(cache: "PrefixCache") -> List[dict]:
+    """The index's JSON-able structure for a snapshot, topologically
+    ordered (parents strictly precede children — a parent's ``start`` is
+    strictly smaller, so a ``start`` sort is a topological sort; ties
+    are independent chains). Opaque device payloads (ring seams,
+    terminal logits) are NOT here — the engine persists those next to
+    the page bytes; these records carry the addressing and the tokens
+    the verifier recomputes digests from."""
+    nodes = sorted(cache.nodes(), key=lambda n: (n.start, n.digest))
+    return [
+        {
+            "digest": n.digest.hex(),
+            "parent": None if n.parent is None else n.parent.hex(),
+            "tokens": [int(t) for t in np.asarray(n.tokens).reshape(-1)],
+            "start": int(n.start),
+            "page_id": int(n.page_id),
+            "has_ring": n.ring is not None,
+            "has_logits": n.logits is not None,
+        }
+        for n in nodes
+    ]
+
+
+def verify_snapshot_records(records: List[dict],
+                            page_size: int) -> Tuple[bool, str]:
+    """Mandatory verify-on-load for a persisted index: every record's
+    digest must RECOMPUTE from its parent digest + stored tokens (a
+    flipped token or forged digest fails here), parents must precede
+    their children, block sizes must fit the page, and coverage must be
+    contiguous from the parent. -> (ok, reason); any failure rejects
+    the WHOLE snapshot — the engine falls back to a cold index rather
+    than mapping unverified K/V."""
+    seen: Dict[str, dict] = {}
+    for i, rec in enumerate(records):
+        try:
+            tokens = np.asarray(rec["tokens"], np.int64)
+            start = int(rec["start"])
+            digest = bytes.fromhex(rec["digest"])
+            parent_hex = rec["parent"]
+        except (KeyError, TypeError, ValueError) as e:
+            return False, f"record {i}: malformed ({e})"
+        if rec["digest"] in seen:
+            return False, (
+                f"record {i}: duplicate chain node (dedup-on-insert "
+                "would be violated at restore)"
+            )
+        if not (0 < len(tokens) <= page_size):
+            return False, (
+                f"record {i}: block of {len(tokens)} tokens does not fit "
+                f"page size {page_size}"
+            )
+        if parent_hex is None:
+            parent_bytes = None
+            if start != 0:
+                return False, f"record {i}: root block at start {start}"
+        else:
+            parent = seen.get(parent_hex)
+            if parent is None:
+                return False, (
+                    f"record {i}: parent {parent_hex[:12]} missing or "
+                    "out of order"
+                )
+            parent_bytes = bytes.fromhex(parent_hex)
+            expect = int(parent["start"]) + len(parent["tokens"])
+            if start != expect:
+                return False, (
+                    f"record {i}: start {start} not contiguous with "
+                    f"parent coverage {expect}"
+                )
+        if chain_digest(parent_bytes, tokens) != digest:
+            return False, (
+                f"record {i}: stored digest does not recompute from its "
+                "tokens (corrupt block or forged address)"
+            )
+        seen[rec["digest"]] = rec
+    return True, "ok"
 
 
 @dataclass

@@ -63,6 +63,20 @@ A request's ``deadline`` stays an absolute instant on the ONE shared
 clock injected into every replica, so a deadline that expires during
 failover means the same moment on the new replica as on the old.
 
+**Resurrection & durability** (docs/DESIGN.md §8.3). With
+``RouterConfig.respawn`` on, a DEAD replica (any reason except an
+operator drain) is rebuilt as a fresh ``Engine`` from the same
+params/config after an exponential backoff — DEAD → RESPAWNING →
+HEALTHY, the breaker's readmission discipline applied to process death
+(``replica_respawn_fail`` injectable; ``max_respawns`` consecutive
+failures retire it for good). A RESPAWNING replica's stale engine is
+as abandoned as a dead one's, but its pending return HOLDS the
+no-replica flush: queued work waits for the fleet to come back. With a
+``RequestJournal`` attached, every admission and terminal outcome is
+WAL-logged so a full-process crash replays unfinished requests
+bit-identically on restart (serving/journal.py), and ``shutdown()`` is
+the SIGTERM path: fleet-wide drain, journal seal, prefix snapshot.
+
 **Global admission & load shedding.** The router's own bounded queue
 rejects typed ``queue_full`` (with a ``router.shed`` event); demand that
 can never fit a replica rejects ``demand_exceeds_pool``; a fleet with
@@ -96,6 +110,7 @@ from ..utils.metrics import counters, gauges, histograms
 from ..utils.resilience import RetryPolicy
 from ..utils.telemetry import TELEMETRY
 from .engine import Engine, EngineConfig
+from .journal import RequestJournal
 from .types import Clock, Outcome, RejectReason, Request, RequestResult
 
 
@@ -107,6 +122,11 @@ class ReplicaState(str, Enum):
     DEGRADED = "degraded"    # breaker open: no new admissions, serving
     DRAINING = "draining"    # operator drain: no new admissions, finishing
     DEAD = "dead"            # crashed / stalled / corrupt / retired
+    # respawn policy (RouterConfig.respawn): awaiting its backoff-
+    # scheduled rebuild — a fresh Engine from the same params/config.
+    # The stale engine is already abandoned (in-flight work failed over
+    # at death); the replica is not serving and not steppable.
+    RESPAWNING = "respawning"
 
 
 _STATE_CODE = {
@@ -114,7 +134,13 @@ _STATE_CODE = {
     ReplicaState.DEGRADED: 1,
     ReplicaState.DRAINING: 2,
     ReplicaState.DEAD: 3,
+    ReplicaState.RESPAWNING: 4,
 }
+
+# states with a live, steppable engine (a RESPAWNING replica's engine is
+# as abandoned as a DEAD one's — excluded from stepping, harvesting,
+# occupancy aggregation, and engine-level invariant checks)
+_ENGINE_DOWN = (ReplicaState.DEAD, ReplicaState.RESPAWNING)
 
 
 @dataclass(frozen=True)
@@ -138,6 +164,19 @@ class RouterConfig:
     stall_timeout_s: float = 30.0
     # replica deaths one request survives before the typed preempt_cap
     max_failovers: int = 3
+    # replica resurrection: a DEAD replica (any reason except an operator
+    # drain) is rebuilt as a fresh Engine from the same params/config
+    # after a respawn_backoff delay (DEAD -> RESPAWNING -> HEALTHY; the
+    # readmission discipline of the circuit breaker, applied to process
+    # death). Failed attempts (``replica_respawn_fail``) back off
+    # further; max_respawns consecutive failures retire the replica for
+    # good. A successful respawn resets the ladder.
+    respawn: bool = False
+    max_respawns: int = 3
+    respawn_backoff: RetryPolicy = RetryPolicy(
+        attempts=3, base_delay=1.0, max_delay=60.0, jitter=0.0,
+        retry_on=(),
+    )
 
 
 @dataclass
@@ -168,14 +207,23 @@ class _Replica:
         self.state = ReplicaState.HEALTHY
         self.inflight: Dict[str, _RouterEntry] = {}
         self.death_reason: Optional[str] = None
+        self.skip_steps = 0          # injected stall: steps to skip
+        # respawn bookkeeping (RouterConfig.respawn)
+        self.respawns = 0            # consecutive scheduled respawns
+        self.respawn_at: Optional[float] = None
+        self.death_t: Optional[float] = None
+        self._reset_health(now)
+
+    def _reset_health(self, now: float) -> None:
+        """(Re)baseline every health signal — at construction AND at
+        respawn. The baselines snapshot the CURRENT process-global
+        labeled counters: a second Router in the same process (smoke/
+        bench run clean + chaos passes back to back), or a respawned
+        engine reusing this replica's label, must not inherit earlier
+        retries as a spurious first-check delta that pops the breaker
+        before any failure happened."""
         # heartbeat
         self.last_progress_t = now
-        self.skip_steps = 0          # injected stall: steps to skip
-        # health baselines snapshot the CURRENT process-global labeled
-        # counters — a second Router in the same process (smoke/bench run
-        # clean + chaos passes back to back) must not inherit the previous
-        # fleet's retries as a spurious first-check delta that pops its
-        # breaker before any failure happened
         self.last_progress_val = self.progress_value()
         self.seen_retries = counters.get(
             "serve.prefill_retries", labels=self.labels
@@ -185,6 +233,18 @@ class _Replica:
         self.breaker_consec = 0      # consecutive prefill failures
         self.breaker_trips = 0       # consecutive openings w/o a success
         self.retry_at: Optional[float] = None
+
+    def rebind(self, engine: Engine, now: float) -> None:
+        """Complete a respawn: adopt the fresh engine, rejoin the fleet
+        HEALTHY, and close the respawn ladder (a successful resurrection
+        resets it, like a successful admission closes the breaker's)."""
+        self.engine = engine
+        self.state = ReplicaState.HEALTHY
+        self.death_reason = None
+        self.respawns = 0
+        self.respawn_at = None
+        self.skip_steps = 0
+        self._reset_health(now)
 
     @property
     def labels(self) -> dict:
@@ -219,27 +279,31 @@ class Router:
 
     _GUARDED_BY = {
         "_lock": ("_queue", "results", "_live", "_spans",
-                  "_outcome_counts", "_seq", "_submitted"),
+                  "_outcome_counts", "_seq", "_submitted",
+                  "_draining_fleet"),
     }
 
     def __init__(self, dalle, params, config: RouterConfig = RouterConfig(),
                  engine_config: EngineConfig = EngineConfig(),
-                 clock: Optional[Clock] = None):
+                 clock: Optional[Clock] = None,
+                 journal: Optional[RequestJournal] = None):
         assert config.n_replicas >= 1, config.n_replicas
         self.config = config
         self._lock = threading.RLock()
         self.clock = clock or Clock()
+        # the respawn policy rebuilds a dead replica's engine from
+        # exactly these — the same params/config every original got
+        self._dalle = dalle
+        self._params = params
+        self._engine_config = engine_config
+        # durable request journal (serving/journal.py): admissions and
+        # terminal outcomes are logged so a full-process crash replays
+        # unfinished requests bit-identically on restart. None = no
+        # durability (the historical behavior).
+        self._journal = journal
         now = self.clock.now()
         self._replicas: List[_Replica] = [
-            _Replica(
-                i,
-                Engine(
-                    dalle, params, engine_config, clock=self.clock,
-                    metric_labels={"replica": str(i)},
-                    fleet_occupancy=self.fleet_occupancy,
-                ),
-                now,
-            )
+            _Replica(i, self._build_engine(i), now)
             for i in range(config.n_replicas)
         ]
         self._queue: List[_RouterEntry] = []
@@ -249,6 +313,17 @@ class Router:
         self._live: set = set()
         self._seq = 0
         self._submitted = 0
+        self._draining_fleet = False
+
+    def _build_engine(self, rid: int) -> Engine:
+        """One replica's engine — used at construction and by every
+        respawn, so a resurrected replica is the same build as the
+        original (same model, params, config, shared clock, labels)."""
+        return Engine(
+            self._dalle, self._params, self._engine_config,
+            clock=self.clock, metric_labels={"replica": str(rid)},
+            fleet_occupancy=self.fleet_occupancy,
+        )
 
     # ------------------------------------------------------------ public
 
@@ -295,6 +370,10 @@ class Router:
                 )
                 counters.inc("router.shed")
                 return self._reject_locked(entry, RejectReason.QUEUE_FULL)
+            if self._journal is not None:
+                # journal AFTER every typed-reject gate: the WAL holds
+                # exactly the requests the fleet owes a terminal outcome
+                self._journal.append_admitted(request, now)
             self._queue.append(entry)
             self._live.add(request.request_id)
             return None
@@ -321,10 +400,23 @@ class Router:
         """Graceful drain: stop admitting to the replica, let in-flight
         work finish, then retire it. Requests still queued at the router
         simply route to siblings (the ``can_admit`` dispatch gate means a
-        replica's internal queue is already empty)."""
+        replica's internal queue is already empty). Draining a
+        RESPAWNING replica retires it immediately — its stale engine is
+        already abandoned (nothing to finish) and a drain is operator
+        retirement, so the pending respawn is cancelled rather than the
+        dead engine re-activated."""
         with self._lock:
             r = self._replicas[replica_id]
             if r.state in (ReplicaState.DEAD, ReplicaState.DRAINING):
+                return
+            if r.state is ReplicaState.RESPAWNING:
+                r.state = ReplicaState.DEAD
+                r.respawn_at = None
+                r.death_reason = "drained"
+                counters.inc("router.drains")
+                counters.inc("router.drained")
+                TELEMETRY.event("router.drain", replica=r.id, inflight=0)
+                TELEMETRY.event("router.drained", replica=r.id)
                 return
             r.state = ReplicaState.DRAINING
             counters.inc("router.drains")
@@ -341,6 +433,84 @@ class Router:
             if r.state is not ReplicaState.DEAD:
                 self._kill_locked(r, reason)
 
+    def shutdown(self, snapshot_dir: Optional[str] = None,
+                 max_steps: int = 10_000) -> None:
+        """SIGTERM graceful drain (the serving analog of the trainer's
+        emergency checkpoint; wired to ``PreemptionHandler.on_signal``
+        by bench.py --serve and the smoke tools): stop admissions
+        fleet-wide, drive until in-flight work finishes, then flush
+        durable state — the journal is SEALED (sidecar manifest) and
+        the prefix cache snapshotted to ``snapshot_dir`` (from the
+        first live prefix-enabled engine). Requests still queued are
+        deliberately NOT flushed typed: they stay journaled-unfinished,
+        which is exactly what makes the next incarnation replay them
+        bit-identically."""
+        with self._lock:
+            self._draining_fleet = True
+            for r in self._replicas:
+                if r.state in (ReplicaState.HEALTHY, ReplicaState.DEGRADED):
+                    r.state = ReplicaState.DRAINING
+                    counters.inc("router.drains")
+                    TELEMETRY.event(
+                        "router.drain", replica=r.id,
+                        inflight=len(r.inflight),
+                    )
+        steps = 0
+        while True:
+            with self._lock:
+                busy = any(r.inflight for r in self._replicas)
+            if not busy:
+                break
+            self.step()
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"shutdown drain made no progress in {max_steps} steps"
+                )
+        with self._lock:
+            if snapshot_dir is not None:
+                # snapshot the RICHEST non-empty index. A replica the
+                # drain above just retired is eligible — "drained" means
+                # its engine finished cleanly and its index is intact —
+                # but crashed/corrupt engines are not, and an empty
+                # index never overwrites an existing warm snapshot.
+                candidates = [
+                    r for r in self._replicas
+                    if (
+                        r.state not in _ENGINE_DOWN
+                        or r.death_reason == "drained"
+                    )
+                    and r.engine.prefix is not None
+                    and len(r.engine.prefix)
+                ]
+                if candidates:
+                    best = max(
+                        candidates, key=lambda r: len(r.engine.prefix)
+                    )
+                    best.engine.save_prefix_snapshot(snapshot_dir)
+            if self._journal is not None:
+                self._journal.seal()
+
+    def live_requests(self) -> List[Request]:
+        """Restorable descriptors of everything the fleet still owes a
+        terminal outcome: router-queued requests (submission order) then
+        per-replica in-flight ones — the crash-recovery export surface
+        (journaled admissions already cover these; this is the
+        journal-free export path and the invariant tests' oracle)."""
+        with self._lock:
+            queued = [
+                e.request
+                for e in sorted(self._queue, key=lambda e: e.seq)
+            ]
+            inflight = [
+                entry.request
+                for r in self._replicas
+                for entry in sorted(
+                    r.inflight.values(), key=lambda e: e.seq
+                )
+            ]
+            return queued + inflight
+
     def step(self) -> bool:
         """One fleet scheduling iteration: fault injections -> router
         deadline sweep -> drive + harvest every live replica -> health
@@ -353,7 +523,7 @@ class Router:
             self._sweep_queue_deadlines_locked()
             stepped = 0
             for r in self._replicas:
-                if r.state is ReplicaState.DEAD:
+                if r.state in _ENGINE_DOWN:
                     continue
                 if r.skip_steps > 0:
                     r.skip_steps -= 1   # injected stall: the engine hangs
@@ -362,8 +532,9 @@ class Router:
                     stepped += 1
                 self._harvest_locked(r)
             for r in self._replicas:
-                if r.state is not ReplicaState.DEAD:
+                if r.state not in _ENGINE_DOWN:
                     self._health_check_locked(r)
+            self._respawn_sweep_locked()
             for r in self._replicas:
                 if (
                     r.state is ReplicaState.DRAINING
@@ -376,7 +547,14 @@ class Router:
                     counters.inc("router.drained")
                     TELEMETRY.event("router.drained", replica=r.id)
             self._dispatch_locked()
-            if all(r.state is ReplicaState.DEAD for r in self._replicas):
+            # RESPAWNING replicas hold the flush: the fleet will come
+            # back, so queued work WAITS instead of flushing typed (a
+            # shutdown drain also holds it — queued work stays journaled
+            # for the next incarnation to replay)
+            if (
+                all(r.state is ReplicaState.DEAD for r in self._replicas)
+                and not self._draining_fleet
+            ):
                 self._flush_no_replica_locked()
             if stepped == 0:
                 # every replica dead/stalled: time must still advance
@@ -415,7 +593,7 @@ class Router:
         mid-step callback — the RLock)."""
         with self._lock:
             live = [
-                r for r in self._replicas if r.state is not ReplicaState.DEAD
+                r for r in self._replicas if r.state not in _ENGINE_DOWN
             ]
             total = sum(r.engine.pool.total for r in live)
             if total == 0:
@@ -442,6 +620,7 @@ class Router:
                         "inflight": len(r.inflight),
                         "pool_occupancy": r.engine.pool.occupancy,
                         "breaker_trips": r.breaker_trips,
+                        "respawns": r.respawns,
                     }
                     for r in self._replicas
                 },
@@ -473,11 +652,16 @@ class Router:
             outcomes = self.stats()["outcomes"]
             assert sum(outcomes.values()) == len(self.results), outcomes
             for r in self._replicas:
-                if r.state is not ReplicaState.DEAD:
+                if r.state not in _ENGINE_DOWN:
                     r.engine.verify_invariants()
                     assert r.engine._live <= set(r.inflight), (
                         f"replica {r.id} serving untracked requests "
                         f"{sorted(r.engine._live - set(r.inflight))}"
+                    )
+                else:
+                    assert not r.inflight, (
+                        f"replica {r.id} is {r.state.value} but still "
+                        f"tracks in-flight work {sorted(r.inflight)}"
                     )
 
     # ---------------------------------------------------------- injections
@@ -502,7 +686,7 @@ class Router:
             self._open_breaker_locked(healthy[0], "health_flap")
 
     def _busiest_live(self) -> Optional[_Replica]:
-        live = [r for r in self._replicas if r.state is not ReplicaState.DEAD]
+        live = [r for r in self._replicas if r.state not in _ENGINE_DOWN]
         if not live:
             return None
         return max(live, key=lambda r: (len(r.inflight), -r.id))
@@ -585,6 +769,9 @@ class Router:
         r.death_reason = reason
         counters.inc("router.replica_deaths")
         now = self.clock.now()
+        r.death_t = now
+        if self.config.respawn:
+            self._schedule_respawn_locked(r)
         TELEMETRY.event(
             "router.failover", replica=r.id, reason=reason,
             inflight=len(r.inflight),
@@ -603,6 +790,65 @@ class Router:
             else:
                 self._queue.append(entry)
         r.inflight.clear()
+
+    # ----------------------------------------------------------- respawn
+
+    def _schedule_respawn_locked(self, r: _Replica) -> None:
+        """DEAD -> RESPAWNING with an exponential-backoff rebuild time —
+        or permanently DEAD once the ladder is exhausted. Deterministic
+        like the breaker (jitter deliberately ignored) so chaos drills
+        replay exactly."""
+        if r.respawns >= self.config.max_respawns:
+            r.respawn_at = None
+            r.death_reason = f"{r.death_reason} (respawns exhausted)"
+            TELEMETRY.event(
+                "router.respawn_fail", replica=r.id,
+                attempts=r.respawns, exhausted=True,
+            )
+            return
+        policy = self.config.respawn_backoff
+        delay = min(
+            policy.max_delay, policy.base_delay * (2 ** r.respawns)
+        )
+        r.respawns += 1
+        r.respawn_at = self.clock.now() + delay
+        r.state = ReplicaState.RESPAWNING
+
+    def _respawn_sweep_locked(self) -> None:
+        """Attempt every due respawn: rebuild the engine from the SAME
+        params/config and readmit the replica HEALTHY, re-baselining
+        every health signal. The ``replica_respawn_fail`` fault fails
+        the attempt — back to the backoff ladder (further out each
+        time), permanently DEAD once exhausted."""
+        if self._draining_fleet:
+            return  # a draining fleet resurrects nobody
+        now = self.clock.now()
+        for r in self._replicas:
+            if r.state is not ReplicaState.RESPAWNING:
+                continue
+            if r.respawn_at is None or now < r.respawn_at:
+                continue
+            if FAULTS.take("replica_respawn_fail"):
+                counters.inc("router.fault_replica_respawn_fail")
+                TELEMETRY.event(
+                    "router.respawn_fail", replica=r.id,
+                    attempts=r.respawns, exhausted=False,
+                )
+                r.state = ReplicaState.DEAD
+                self._schedule_respawn_locked(r)
+                continue
+            r.rebind(self._build_engine(r.id), now)
+            counters.inc("router.respawns")
+            recovery = None if r.death_t is None else now - r.death_t
+            if recovery is not None:
+                # kill -> healthy MTTR, per replica (the bench.py --serve
+                # recovery record reads this histogram)
+                histograms.observe(
+                    "serve.recovery_s", recovery, labels=r.labels
+                )
+            TELEMETRY.event(
+                "router.respawn", replica=r.id, recovery_s=recovery,
+            )
 
     def _flush_no_replica_locked(self) -> None:
         """Fleet fully dead: every queued request ends typed rather than
@@ -702,6 +948,11 @@ class Router:
         )
         self._live.discard(entry.request_id)
         self.results[entry.request_id] = result
+        if self._journal is not None:
+            # the completion record that makes crash replay idempotent
+            self._journal.append_outcome(
+                entry.request_id, result.outcome.value, self.clock.now()
+            )
         self._outcome_counts[result.outcome] += 1
         counters.inc(f"router.{result.outcome.value}")
         TELEMETRY.end(
@@ -718,7 +969,7 @@ class Router:
         gauges.set("router.queued", len(self._queue))
         gauges.set("router.fleet_occupancy", self.fleet_occupancy())
         gauges.set("router.replicas_live", sum(
-            r.state is not ReplicaState.DEAD for r in self._replicas
+            r.state not in _ENGINE_DOWN for r in self._replicas
         ))
         for r in self._replicas:
             gauges.set(

@@ -283,6 +283,11 @@ class Scheduler:
         """Request ids of every queued entry (invariant checks)."""
         return {e.request_id for (_, _, e) in self._heap}
 
+    def entries(self) -> List[Entry]:
+        """Every queued entry in submission order (crash-recovery
+        export: a restart harness re-journals what was still queued)."""
+        return sorted((e for (_, _, e) in self._heap), key=lambda e: e.seq)
+
     def pop(self) -> Entry:
         entry = heapq.heappop(self._heap)[2]
         self._size -= entry.counted

@@ -67,6 +67,20 @@ Sites in use:
                  by construction (exact acceptance makes a width-1
                  verify row a plain decode row) and the fallback is
                  counted (``serve.spec.fallbacks``)
+``replica_respawn_fail`` ``serving.router``: a scheduled replica
+                 respawn attempt fails (the rebuilt engine never comes
+                 up) — the respawn state machine must back off and
+                 retry, escalating to permanently DEAD only after
+                 ``max_respawns`` failures
+``journal_torn`` ``serving.journal``: the request journal's tail record
+                 is truncated mid-append (a crash tore the last write) —
+                 the loader must DROP the torn tail, count it
+                 (``serve.journal.torn``), and replay the intact prefix
+``snapshot_corrupt`` ``serving.engine``: a prefix-cache snapshot fails
+                 its mandatory verify-on-load (a token block no longer
+                 matches its chain digest) — the whole snapshot is
+                 REJECTED (``serve.snapshot.rejected``) and the engine
+                 falls back to a cold index, never mapping corrupt K/V
 ===============  =============================================================
 
 Injection must be impossible to leave on by accident: the registry is
@@ -96,6 +110,7 @@ KNOWN_SITES = frozenset({
     "replica_crash", "replica_stall", "health_flap",
     "prefix_hash_collide", "prefix_publish_fail",
     "spec_verify_abort",
+    "replica_respawn_fail", "journal_torn", "snapshot_corrupt",
 })
 
 

@@ -59,7 +59,10 @@ EVENTS = frozenset({
     "serve.decode_stall",
     "serve.prefill_retry",
     "serve.prefix_hit",      # admission mapped >=1 cached prompt page
+    "serve.snapshot_reject", # prefix snapshot failed verify-on-load
     # replicated front door
+    "router.respawn",        # dead replica rebuilt and readmitted HEALTHY
+    "router.respawn_fail",   # a respawn attempt failed (or exhausted)
     "router.shed",
     "router.drain",
     "router.drained",
@@ -109,6 +112,15 @@ COUNTERS = frozenset({
     "serve.fault_prefix_hash_collide",
     "serve.fault_prefix_publish_fail",
     "serve.fault_spec_verify_abort",
+    "serve.fault_journal_torn",
+    "serve.fault_snapshot_corrupt",
+    # crash recovery (serving/journal.py + engine snapshot; §8.3)
+    "serve.journal.appended",   # admitted-request WAL records written
+    "serve.journal.replayed",   # unfinished requests resubmitted on restart
+    "serve.journal.torn",       # torn tail records detected and dropped
+    "serve.snapshot.saved",     # prefix-cache snapshots committed to disk
+    "serve.snapshot.restored",  # snapshots verified and restored (warm start)
+    "serve.snapshot.rejected",  # snapshots refused by verify-on-load
     # speculative decoding (serving/engine.py:_spec_iteration)
     "serve.spec.drafted",     # draft tokens proposed to verify rows
     "serve.spec.accepted",    # drafts committed by exact-match acceptance
@@ -136,6 +148,8 @@ COUNTERS = frozenset({
     "router.fault_replica_crash",
     "router.fault_replica_stall",
     "router.fault_health_flap",
+    "router.fault_replica_respawn_fail",
+    "router.respawns",          # dead replicas rebuilt and readmitted
     # typed-outcome tallies (f"router.{outcome.value}" expansions)
     "router.completed",
     "router.rejected",
@@ -191,6 +205,9 @@ HISTOGRAMS = frozenset({
     # tokens committed per speculative verify step (1 .. spec_k+1); the
     # bench's accepted-tokens-per-step distribution reads this
     "serve.spec_accepted_per_step",
+    # replica kill -> healthy-again (respawn) MTTR, per replica label —
+    # the bench recovery record's source
+    "serve.recovery_s",
 })
 
 # span durations are auto-observed as "<span>_s" (utils/telemetry.py);

@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""Chaos soak gate: a seeded randomized fault schedule against the
+serving fleet, with recovery (respawn + process restart) in the loop.
+
+Every robustness mechanism the serving stack owns is exercised from ONE
+randomized schedule instead of one-fault-at-a-time drills: each
+iteration the seeded RNG may arm any serving fault site
+(``page_exhaust``, ``prefill_fail``, ``decode_stall``,
+``request_cancel``, ``replica_crash``, ``replica_stall``,
+``health_flap``, ``prefix_hash_collide``, ``prefix_publish_fail``,
+``replica_respawn_fail``), and at randomized points the WHOLE PROCESS
+"crashes": the router object is abandoned mid-flight exactly as a dead
+process would leave it (journal unsealed, in-flight work lost), a fresh
+router is built, the prefix-cache snapshot is verify-loaded
+(``snapshot_corrupt`` armable here), and the journal replays unfinished
+requests (``journal_torn`` armable here — a torn tail is dropped and
+the harness resubmits it as the client retry the contract prescribes).
+Training-side sites (``download``, ``shard_open``, ...) have no take
+site in the serving loop and are deliberately not scheduled.
+
+The gate, checked every iteration and at the end:
+
+* ``Router.verify_invariants`` clean EVERY iteration — accounting can
+  never drift, even transiently;
+* 100% typed-outcome accounting: every submitted request ends in
+  exactly one typed outcome, across crashes and restarts;
+* bit-parity: every COMPLETED request's tokens equal a fault-free
+  reference run's (the (seed, position) replay contract); a request
+  re-delivered after an outcome-record loss must match its original
+  delivery bitwise (replay idempotency);
+* at least one request completes (a soak that rejects everything is a
+  failed soak, not a passed one).
+
+Quick deterministic mode (the default: ``--iters 120 --seed 0``) is the
+fast-tier subprocess gate (tests/test_recovery.py); longer soaks ride
+``--iters``/``--seed`` sweeps behind the slow tier::
+
+    python tools/chaos_soak.py
+    python tools/chaos_soak.py --iters 2000 --seed 7 --replicas 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tools"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# fault sites with a take-site reachable from the router loop, and the
+# per-iteration probability of arming each (seeded RNG)
+SCHEDULED_SITES = (
+    "page_exhaust", "prefill_fail", "decode_stall", "request_cancel",
+    "replica_crash", "replica_stall", "health_flap",
+    "prefix_hash_collide", "prefix_publish_fail", "replica_respawn_fail",
+)
+# restart-time sites: armed just before a journal/snapshot load
+RESTART_SITES = ("journal_torn", "snapshot_corrupt")
+
+
+def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
+             fault_p: float, restart_every: int, snap_every: int) -> dict:
+    import numpy as np
+
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, FakeClock, Outcome, Request, RequestJournal,
+        Router, RouterConfig, replay_unfinished,
+    )
+    from dalle_pytorch_tpu.utils.faults import FAULTS
+    from serve_smoke import build_tiny_model
+
+    dalle, params = build_tiny_model()
+    rng = np.random.RandomState(seed)
+    prompts = [
+        rng.randint(1, 16, size=(4,)).astype(np.int32) for _ in range(n_req)
+    ]
+    # a few shared prompts so the prefix cache sees real reuse
+    for i in range(3, n_req, 3):
+        prompts[i] = prompts[0]
+    requests = [
+        Request(
+            request_id=f"soak{i}", prompt=prompts[i],
+            max_new_tokens=dalle.image_seq_len, seed=1000 + i,
+        )
+        for i in range(n_req)
+    ]
+
+    # fault-free reference: the bit-parity oracle for every survivor
+    ref_engine = Engine(
+        dalle, params, EngineConfig(max_batch=2, prefill_chunk=2)
+    )
+    for req in requests:
+        assert ref_engine.submit(req) is None
+    reference = {
+        rid: np.asarray(res.tokens)
+        for rid, res in ref_engine.run(max_steps=20_000).items()
+    }
+
+    tmp = tempfile.mkdtemp(prefix="chaos_soak_")
+    jpath = os.path.join(tmp, "journal.jsonl")
+    snapdir = os.path.join(tmp, "prefix_snapshot")
+    engine_cfg = EngineConfig(
+        max_batch=2, prefill_chunk=2, prefix_cache=True,
+    )
+    router_cfg = RouterConfig(
+        n_replicas=n_replicas, respawn=True,
+        stall_timeout_s=5.0, queue_limit=4 * n_req,
+    )
+    clock = FakeClock(step_dt=0.25)
+
+    def build_router() -> Router:
+        return Router(
+            dalle, params, router_cfg, engine_cfg, clock=clock,
+            journal=RequestJournal(jpath),
+        )
+
+    FAULTS.reset()
+    router = build_router()
+    delivered: dict = {}        # rid -> RequestResult, the "client" view
+    submitted: set = set()
+    armed_total: dict = {}
+    restarts = 0
+    snapshots = 0
+    torn_total = 0
+    next_req = 0
+
+    def poll_results():
+        """Deliver new terminal results to the 'client'; a re-delivered
+        COMPLETED result (outcome record lost to a crash) must match the
+        original bitwise — replay idempotency."""
+        for rid, res in router.results.items():
+            if not rid.startswith("soak"):
+                continue
+            if rid in delivered:
+                prev = delivered[rid]
+                if (
+                    res.outcome is Outcome.COMPLETED
+                    and prev.outcome is Outcome.COMPLETED
+                ):
+                    assert np.array_equal(
+                        np.asarray(res.tokens), np.asarray(prev.tokens)
+                    ), f"{rid}: re-delivered tokens diverge from original"
+                continue
+            delivered[rid] = res
+
+    def restart():
+        """Process death: abandon the router mid-flight, rebuild, load
+        the snapshot (verify-on-load), replay the journal, resubmit
+        anything a torn tail dropped (the client-retry contract)."""
+        nonlocal router, restarts, torn_total
+        restarts += 1
+        router._journal.close()  # what a dead process leaves behind
+        if rng.random() < 0.5:
+            FAULTS.arm("journal_torn", 1)
+            armed_total["journal_torn"] = (
+                armed_total.get("journal_torn", 0) + 1
+            )
+        if rng.random() < 0.5:
+            FAULTS.arm("snapshot_corrupt", 1)
+            armed_total["snapshot_corrupt"] = (
+                armed_total.get("snapshot_corrupt", 0) + 1
+            )
+        router = build_router()
+        if Path(snapdir).exists():
+            for r in router._replicas:
+                if not r.engine.load_prefix_snapshot(snapdir):
+                    break  # rejected (corrupt/uncommitted): cold fleet
+        torn0 = FAULTS.fired.get("journal_torn", 0)
+        replayed = set(replay_unfinished(
+            jpath, router.submit, now=clock.now()
+        ))
+        torn_total += FAULTS.fired.get("journal_torn", 0) - torn0
+        # resubmit what the journal lost (torn tail): the client retry
+        # the torn-tail contract prescribes (delivered requests and
+        # replayed ones are already accounted)
+        for req in requests[:next_req]:
+            rid = req.request_id
+            if rid in delivered or rid in replayed:
+                continue
+            if rid in router.results:
+                continue
+            if router.submit(req) is not None:
+                pass  # typed immediate reject lands in results
+
+    for it in range(iters):
+        # staggered arrivals: ~one submission every other iteration
+        if next_req < n_req and rng.random() < 0.6:
+            req = requests[next_req]
+            submitted.add(req.request_id)
+            next_req += 1
+            rejected = router.submit(req)
+            if rejected is not None:
+                delivered[req.request_id] = rejected
+        if rng.random() < fault_p:
+            site = SCHEDULED_SITES[rng.randint(len(SCHEDULED_SITES))]
+            FAULTS.arm(site, 1)
+            armed_total[site] = armed_total.get(site, 0) + 1
+        if snap_every and it and it % snap_every == 0:
+            for r in router._replicas:
+                if (
+                    r.state.value in ("healthy", "degraded", "draining")
+                    and r.engine.prefix is not None
+                    and len(r.engine.prefix)
+                ):
+                    r.engine.save_prefix_snapshot(snapdir)
+                    snapshots += 1
+                    break
+        if restart_every and it and it % restart_every == 0:
+            restart()
+        router.step()
+        router.verify_invariants()
+        poll_results()
+
+    # quiesce: no new faults, drive everything to a terminal outcome
+    # (leftover armed faults would keep killing a fleet trying to finish)
+    fired = dict(FAULTS.fired)
+    FAULTS.reset()
+    steps = 0
+    while True:
+        poll_results()
+        missing = submitted - set(delivered)
+        if not missing:
+            break
+        # client retry for anything lost without a typed record visible
+        # to this incarnation (torn admissions after a crash)
+        for req in requests[:next_req]:
+            rid = req.request_id
+            if (
+                rid in missing
+                and rid not in router.results
+                and rid not in set(
+                    r.request_id for r in router.live_requests()
+                )
+            ):
+                router.submit(req)
+        router.step()
+        steps += 1
+        router.verify_invariants()
+        assert steps < 20_000, (
+            f"soak quiesce made no progress: {sorted(missing)} undelivered"
+        )
+    router.verify_invariants()
+
+    # ---- the gate ----
+    outcomes: dict = {}
+    mismatches = []
+    for rid in sorted(submitted):
+        res = delivered[rid]
+        outcomes[res.outcome.value] = outcomes.get(res.outcome.value, 0) + 1
+        if res.outcome is Outcome.COMPLETED and not np.array_equal(
+            np.asarray(res.tokens), reference[rid]
+        ):
+            mismatches.append(rid)
+    completed = outcomes.get("completed", 0)
+    ok = not mismatches and completed >= 1 and len(delivered) >= len(submitted)
+    return {
+        "ok": bool(ok),
+        "iters": iters,
+        "seed": seed,
+        "n_replicas": n_replicas,
+        "submitted": len(submitted),
+        "outcomes": outcomes,
+        "completed_bit_identical": not mismatches,
+        "mismatched": mismatches,
+        "faults_armed": armed_total,
+        "faults_fired": fired,
+        "restarts": restarts,
+        "snapshots_saved": snapshots,
+        "journal_torn_dropped": torn_total,
+        "replica_states": router.replica_states(),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--iters", type=int, default=120,
+                    help="fault-injection iterations (quick gate default)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--fault-p", type=float, default=0.25,
+                    help="per-iteration probability of arming a fault")
+    ap.add_argument("--restart-every", type=int, default=40,
+                    help="process-crash-and-restart period (0 = never)")
+    ap.add_argument("--snap-every", type=int, default=15,
+                    help="prefix snapshot period (0 = never)")
+    args = ap.parse_args(argv)
+
+    summary = run_soak(
+        iters=args.iters, seed=args.seed, n_replicas=args.replicas,
+        n_req=args.requests, fault_p=args.fault_p,
+        restart_every=args.restart_every, snap_every=args.snap_every,
+    )
+    print(json.dumps(summary, indent=1, sort_keys=True))
+    if not summary["ok"]:
+        print("chaos soak FAILED", file=sys.stderr)
+        return 1
+    print(
+        f"chaos soak OK: {summary['submitted']} requests all typed across "
+        f"{summary['restarts']} process restarts, completed survivors "
+        "bit-identical to the fault-free reference", file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,7 +111,8 @@ def build_tiny_model():
     return dalle, params
 
 
-def run_replicated_drill(dalle, params, n_replicas: int) -> bool:
+def run_replicated_drill(dalle, params, n_replicas: int,
+                         preempt=None) -> bool:
     """The --replicas chaos drill: kill one replica mid-run, require all
     requests COMPLETE with tokens bit-identical to a no-crash pass."""
     import numpy as np
@@ -142,6 +143,11 @@ def run_replicated_drill(dalle, params, n_replicas: int) -> bool:
         while router.step():
             steps += 1
             assert steps < 2000, "replicated drill made no progress"
+            if preempt is not None and preempt.triggered:
+                router.shutdown()
+                print("serve smoke: SIGTERM — fleet drained",
+                      file=sys.stderr)
+                sys.exit(0)
             # arm the kill once work is demonstrably in flight (mid-run),
             # exactly once per pass
             if crash and steps == 3:
@@ -175,13 +181,164 @@ def run_replicated_drill(dalle, params, n_replicas: int) -> bool:
     return ok
 
 
-def main(argv=None) -> int:
+def _drive(router, preempt, snapshot_dir=None, max_steps=2000,
+           label="serve smoke"):
+    """Drive a router to idle, honoring SIGTERM: the preemption handler's
+    flag triggers the serving shutdown path — fleet-wide graceful drain,
+    journal seal, prefix snapshot flush — then a clean exit (the serving
+    analog of the trainer's emergency checkpoint; docs/DESIGN.md §8.3)."""
+    steps = 0
+    while router.step():
+        steps += 1
+        assert steps < max_steps, f"{label}: router made no progress"
+        if preempt is not None and preempt.triggered:
+            router.shutdown(snapshot_dir=snapshot_dir)
+            print(f"{label}: SIGTERM — fleet drained, journal sealed"
+                  + (", snapshot flushed" if snapshot_dir else ""),
+                  file=sys.stderr)
+            sys.exit(0)
+
+
+def run_recovery_drill(dalle, params, preempt=None) -> bool:
+    """The kill-restore-replay pass (docs/DESIGN.md §8.3): a journaled
+    prefix-cache router completes two cold requests, snapshots its warm
+    index, admits two more, and then the process "dies" mid-flight —
+    journal unsealed, router abandoned. A second router restores the
+    snapshot (verify-on-load) and replays the journal's unfinished
+    requests. The gate: every crash-set request COMPLETES with tokens
+    bit-identical to a fault-free reference run, and — when the
+    snapshot verified — at least one post-restart request is a prefix
+    HIT against the restored arena (it comes back *warm*).
+
+    Env-composed drills (the DTL033 registry contract)::
+
+        DALLE_TPU_FAULTS="journal_torn=1" python tools/serve_smoke.py
+        DALLE_TPU_FAULTS="snapshot_corrupt=1" python tools/serve_smoke.py
+
+    A torn tail drops the LAST admitted record — the drill resubmits it
+    as the client retry the contract prescribes (tokens still
+    bit-identical); a corrupt snapshot is verified-rejected and the
+    restart proceeds COLD (no warm-hit requirement, but the rejection
+    must be counted)."""
+    import tempfile
+
     import numpy as np
 
     from dalle_pytorch_tpu.serving import (
-        Engine, EngineConfig, FakeClock, Outcome, Request,
+        Engine, EngineConfig, Outcome, Request, RequestJournal, Router,
+        RouterConfig, replay_unfinished,
     )
+    from dalle_pytorch_tpu.utils.faults import FAULTS
+    from dalle_pytorch_tpu.utils.metrics import counters
 
+    rng = np.random.RandomState(3)
+    tmpl = [rng.randint(1, 16, size=(4,)).astype(np.int32) for _ in range(2)]
+    cold = [
+        Request(request_id="rec0", prompt=tmpl[0], max_new_tokens=4, seed=50),
+        Request(request_id="rec1", prompt=tmpl[1], max_new_tokens=4, seed=51),
+    ]
+    # the crash set: rec2 reuses template 0, so its post-restart replay
+    # must hit the RESTORED index (published by rec0's cold run)
+    crash_set = [
+        Request(request_id="rec2", prompt=tmpl[0], max_new_tokens=4, seed=52),
+        Request(request_id="rec3", prompt=tmpl[1], max_new_tokens=4, seed=53),
+    ]
+
+    ref_engine = Engine(
+        dalle, params, EngineConfig(max_batch=2, prefill_chunk=2)
+    )
+    for req in crash_set:
+        assert ref_engine.submit(req) is None
+    reference = {
+        rid: np.asarray(res.tokens)
+        for rid, res in ref_engine.run(max_steps=1000).items()
+    }
+
+    tmp = tempfile.mkdtemp(prefix="serve_smoke_recovery_")
+    jpath = os.path.join(tmp, "journal.jsonl")
+    snapdir = os.path.join(tmp, "prefix_snapshot")
+    cfg = EngineConfig(max_batch=2, prefill_chunk=2, prefix_cache=True)
+
+    router = Router(
+        dalle, params, RouterConfig(n_replicas=1), cfg,
+        journal=RequestJournal(jpath),
+    )
+    for req in cold:
+        assert router.submit(req) is None
+    _drive(router, preempt, snapshot_dir=snapdir)
+    router.verify_invariants()
+    eng = router._replicas[0].engine
+    eng.save_prefix_snapshot(snapdir)
+    for req in crash_set:
+        assert router.submit(req) is None
+    router.step()
+    router.step()  # demonstrably in flight ...
+    router._journal.close()  # ... and now the process is dead
+
+    # the engine's counters are per-replica labeled series (it lives
+    # under a router) — read the replica-0 series
+    rejected0 = counters.get(
+        "serve.snapshot.rejected", labels={"replica": "0"}
+    )
+    torn0 = counters.get("serve.journal.torn")
+    router2 = Router(
+        dalle, params, RouterConfig(n_replicas=1), cfg,
+        journal=RequestJournal(jpath),
+    )
+    eng2 = router2._replicas[0].engine
+    restored = eng2.load_prefix_snapshot(snapdir)
+    replayed = set(replay_unfinished(
+        jpath, router2.submit, now=router2.clock.now()
+    ))
+    torn = counters.get("serve.journal.torn") - torn0
+    for req in crash_set:
+        # a torn tail lost this admission: the client retries it
+        if req.request_id not in replayed:
+            assert torn > 0, (
+                f"{req.request_id} missing from replay without a torn tail"
+            )
+            assert router2.submit(req) is None
+    _drive(router2, preempt, snapshot_dir=snapdir)
+    router2.verify_invariants()
+
+    ok = True
+    for req in crash_set:
+        res = router2.results[req.request_id]
+        print(json.dumps({"pass": "recovery", **res.to_json()}))
+        if res.outcome is not Outcome.COMPLETED:
+            ok = False
+            print(f"serve smoke FAILED: {req.request_id} did not complete "
+                  f"after restart ({res.outcome.value})", file=sys.stderr)
+        elif not np.array_equal(
+            np.asarray(res.tokens), reference[req.request_id]
+        ):
+            ok = False
+            print(f"serve smoke FAILED: {req.request_id} replayed tokens "
+                  "diverge from the fault-free reference", file=sys.stderr)
+    if restored:
+        if eng2.prefix.stats.hits < 1:
+            ok = False
+            print("serve smoke FAILED: no post-restart request hit the "
+                  "restored prefix snapshot", file=sys.stderr)
+    else:
+        if counters.get(
+            "serve.snapshot.rejected", labels={"replica": "0"}
+        ) <= rejected0:
+            ok = False
+            print("serve smoke FAILED: snapshot load failed without a "
+                  "counted rejection", file=sys.stderr)
+    print(json.dumps({
+        "pass": "recovery",
+        "snapshot_restored": bool(restored),
+        "journal_replayed": sorted(replayed),
+        "journal_torn_dropped": torn,
+        "prefix_hits_after_restart": eng2.prefix.stats.hits,
+        "stats": router2.stats(),
+    }))
+    return ok
+
+
+def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     n_replicas = (
         int(argv[argv.index("--replicas") + 1]) if "--replicas" in argv else 0
@@ -189,6 +346,27 @@ def main(argv=None) -> int:
 
     if lint_preflight() != 0:
         return 1
+
+    from dalle_pytorch_tpu.utils.resilience import PreemptionHandler
+    from dalle_pytorch_tpu.utils.telemetry import TELEMETRY
+
+    # SIGTERM contract (docs/DESIGN.md §8.3, the serving analog of the
+    # trainer's preemption path): the signal hook drains the flight
+    # recorder immediately; the router drive loops poll ``triggered``
+    # and run graceful drain + journal seal + snapshot flush before a
+    # clean exit.
+    with PreemptionHandler(
+        on_signal=lambda s: TELEMETRY.drain("preempt_signal")
+    ) as preempt:
+        return _run_passes(n_replicas, preempt)
+
+
+def _run_passes(n_replicas: int, preempt) -> int:
+    import numpy as np
+
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, FakeClock, Outcome, Request,
+    )
 
     dalle, params = build_tiny_model()
     rng = np.random.RandomState(1)
@@ -350,8 +528,15 @@ def main(argv=None) -> int:
         print("serve smoke FAILED: mid-prefill termination leaked "
               f"{drill.pool.used} pages", file=sys.stderr)
 
+    # kill-restore-replay recovery pass (docs/DESIGN.md §8.3): journaled
+    # router + prefix snapshot survive a mid-flight process death with
+    # bit-identical replay and a warm restored cache
+    ok = run_recovery_drill(dalle, params, preempt) and ok
+
     if n_replicas:
-        ok = run_replicated_drill(dalle, params, n_replicas) and ok
+        ok = run_replicated_drill(
+            dalle, params, n_replicas, preempt=preempt
+        ) and ok
 
     if not ok:
         print("serve smoke FAILED: not every request completed", file=sys.stderr)
@@ -359,7 +544,9 @@ def main(argv=None) -> int:
     print("serve smoke OK: 3/3 completed chunked, monolithic, fused, "
           "SPECULATIVE (exact-acceptance bit-parity) AND the prefix-cache "
           "cold/warm replay (bit-identical, warm round "
-          "hit the index), mid-prefill deadline drill typed, pool drained"
+          "hit the index), mid-prefill deadline drill typed, pool drained, "
+          "kill-restore-replay recovery drill bit-identical with a warm "
+          "restored cache"
           + (f", {2 * n_replicas}/{2 * n_replicas} completed the "
              f"{n_replicas}-replica crash drill bit-identically"
              if n_replicas else ""),

@@ -10,11 +10,22 @@ relying on a checkpoint, e.g. ahead of deleting an older known-good one::
     python tools/verify_ckpt.py dalle-cp
     python tools/verify_ckpt.py dalle-cp --step 1200
 
-Exit status: 0 when every step dir verifies, 1 when any is torn/corrupt
-(the report names the failing file and reason), 2 when none verifies —
-the trainer would refuse to resume from this directory.
+``--serving`` verifies the SERVING durable state instead (docs/DESIGN.md
+§8.3) — operator CLI parity with training checkpoints: the request
+journal (``journal.jsonl``: sidecar manifest when sealed, full parse
+scan with torn-tail reporting either way) and the prefix-cache snapshot
+(``prefix_snapshot/``: two-phase COMMITTED dir manifest plus the
+mandatory chain-digest recompute over every persisted node)::
 
-Imports only the manifest helpers (no jax/orbax), so it runs anywhere.
+    python tools/verify_ckpt.py --serving /var/serve-state
+
+Exit status: 0 when every artifact verifies, 1 when any is torn/corrupt
+(the report names the failing file and reason), 2 when nothing verifies
+— the typed refuse-to-resume outcome (a corrupt journal or snapshot
+must never be replayed/restored from).
+
+Imports only the manifest/journal/record helpers (no jax/orbax), so it
+runs anywhere.
 """
 
 from __future__ import annotations
@@ -28,6 +39,70 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 from dalle_pytorch_tpu.utils.resilience import verify_dir_manifest  # noqa: E402
+
+
+def verify_serving(state_dir: str) -> int:
+    """Verify a serving durable-state dir: ``journal.jsonl`` and/or
+    ``prefix_snapshot/`` (either may be absent — a fleet that never
+    enabled one of them). Exit codes mirror ``verify_root``: 0 all
+    present artifacts verify, 1 some do, 2 none do (or none exist)."""
+    import json as _json
+
+    from dalle_pytorch_tpu.serving.journal import RequestJournal
+    from dalle_pytorch_tpu.serving.prefix_cache import (
+        verify_snapshot_records,
+    )
+
+    root = Path(state_dir)
+    checked = 0
+    bad = 0
+
+    jpath = root / "journal.jsonl"
+    if jpath.exists():
+        checked += 1
+        ok, reason = RequestJournal.verify(str(jpath))
+        if ok:
+            # inspection reads: never move the torn counter or consume
+            # an armed drill — the replay read owns those side effects
+            n = len(RequestJournal.load(str(jpath), count=False)[0])
+            unfinished = len(
+                RequestJournal.unfinished(str(jpath), count=False)
+            )
+            print(f"OK    journal.jsonl  ({n} records, {unfinished} "
+                  f"unfinished; {reason})")
+        else:
+            bad += 1
+            print(f"FAIL  journal.jsonl: {reason}")
+
+    snapdir = root / "prefix_snapshot"
+    if snapdir.is_dir():
+        checked += 1
+        ok, reason = verify_dir_manifest(snapdir)
+        nodes = []
+        if ok:
+            try:
+                index = _json.loads((snapdir / "index.json").read_text())
+                nodes = index["nodes"]
+                ok, reason = verify_snapshot_records(
+                    nodes, int(index["page_size"])
+                )
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                ok, reason = False, f"unreadable index: {e!r}"
+        if ok:
+            print(f"OK    prefix_snapshot  ({len(nodes)} nodes, "
+                  "chain digests recomputed)")
+        else:
+            bad += 1
+            print(f"FAIL  prefix_snapshot: {reason}")
+
+    if checked == 0:
+        print(f"FAIL  {root}: no journal.jsonl or prefix_snapshot/ found")
+        return 2
+    if bad == checked:
+        print(f"no verified serving state under {root} — "
+              "restart would come up cold")
+        return 2
+    return 1 if bad else 0
 
 
 def verify_root(ckpt_dir: str, step: int | None = None) -> int:
@@ -68,10 +143,18 @@ def verify_root(ckpt_dir: str, step: int | None = None) -> int:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("ckpt_dir", help="sharded checkpoint root (the <name>-cp dir)")
+    ap.add_argument("ckpt_dir", help="sharded checkpoint root (the "
+                    "<name>-cp dir), or with --serving a serving "
+                    "durable-state dir")
     ap.add_argument("--step", type=int, default=None,
                     help="verify only this step")
+    ap.add_argument("--serving", action="store_true",
+                    help="verify serving durable state (request journal "
+                    "+ prefix-cache snapshot) instead of training "
+                    "checkpoints")
     args = ap.parse_args(argv)
+    if args.serving:
+        return verify_serving(args.ckpt_dir)
     return verify_root(args.ckpt_dir, args.step)
 
 
