@@ -140,12 +140,27 @@ def peak_hbm_bps() -> float:
     return 819e9
 
 
+def kv_sweep_bytes_per_token(kv_quant: str = "none",
+                             kv_dtype_bytes: int = 2) -> float:
+    """HBM bytes the K + V cache sweep streams per cached position per
+    layer-pair, by KV storage format: ``kv_dtype_bytes`` per element for
+    the unquantized pools (bf16 = 2), or 1 int8 byte per element plus a
+    4-byte f32 scale per (token, head) for ``kv_quant="int8"``
+    (ops/paged_kv.py:quantize_rows) — the recomputed stream-bound input:
+    bytes roughly halve, so the kv_sweep_weight_stream_hbm_roofline
+    bound RISES by the same factor at the sweep-dominated batches."""
+    if kv_quant == "int8":
+        return 2 * HEADS * (DIM_HEAD * 1 + 4)
+    return 2 * HEADS * DIM_HEAD * kv_dtype_bytes
+
+
 def decode_roofline_tokens_per_sec(
     batch: int,
     int8: bool = True,
     depth: int = DEPTH,
     fmap: int = IMAGE_FMAP,
     frontier_avg: float | None = None,
+    kv_quant: str = "none",
 ) -> float:
     """Named bound: **kv_sweep_weight_stream_hbm_roofline** — the decode
     tokens/sec ceiling from HBM bytes alone, derived here so the batch
@@ -183,9 +198,30 @@ def decode_roofline_tokens_per_sec(
         frontier_avg = (-(-t // 128) * 128 + -(-n // 128) * 128) / 2
     wbytes = 1 if int8 else 2
     weight_bytes = depth * 16 * DIM * DIM * wbytes + DIM * NUM_IMAGE * wbytes
-    sweep_bytes = 2 * depth * frontier_avg * HEADS * DIM_HEAD * 2  # bf16 K+V
+    # K+V sweep bytes per position: bf16 by default; kv_quant="int8"
+    # swaps in the quantized stream (int8 + per-head scales) and the
+    # bound rises accordingly — the recomputed int8 stream roofline
+    sweep_bytes = depth * frontier_avg * kv_sweep_bytes_per_token(kv_quant)
     step_bytes = weight_bytes + batch * sweep_bytes
     return batch / (step_bytes / peak_hbm_bps())
+
+
+def _kv_bytes_per_slot(fmt: str, depth: int, fmap: int,
+                       kv_quant: str) -> int:
+    """KV cache bytes one sequence slot occupies across all layers for a
+    given layout format + storage quantization (bf16 elements for the
+    unquantized flagship; int8 + per-(token, head) f32 scales under
+    kv_quant="int8" — paged only: the flat/4d formats never consulted
+    the quant knob). Paged slots round up to whole pages."""
+    from dalle_pytorch_tpu.ops import kv_policy as _kvp, paged_kv as _pkv
+
+    n = TEXT_SEQ + 1 + fmap * fmap  # internal positions incl. <bos>
+    if fmt == "paged":
+        page = _kvp.page_size()
+        n = _pkv.num_pages(n, page) * page
+    else:
+        kv_quant = "none"
+    return int(depth * n * kv_sweep_bytes_per_token(kv_quant))
 
 
 def bench_decode_sweep(on_cpu: bool, batch_sizes=(1, 8, 16, 32, 64),
@@ -245,6 +281,21 @@ def bench_decode_sweep(on_cpu: bool, batch_sizes=(1, 8, 16, 32, 64),
                 "roofline_tokens_per_sec": round(
                     decode_roofline_tokens_per_sec(
                         b, int8=int8, depth=depth, fmap=fmap
+                    ), 1
+                ),
+                # the KV format axis (ops/kv_policy.py kv_quant): what
+                # the pools store, the per-slot KV bytes that implies,
+                # and the RECOMPUTED stream bound under int8 pages —
+                # bytes roughly halve, so the bound rises by the same
+                # factor where sweeps dominate (the quantized-KV lever)
+                "kv_quant": kv_policy.choose_kv_quant(),
+                "kv_bytes_per_slot": _kv_bytes_per_slot(
+                    fmt, depth, fmap, kv_policy.choose_kv_quant()
+                ),
+                "roofline_tokens_per_sec_kv_int8": round(
+                    decode_roofline_tokens_per_sec(
+                        b, int8=int8, depth=depth, fmap=fmap,
+                        kv_quant="int8",
                     ), 1
                 ),
                 "roofline_note": "derived in bench.py:decode_roofline_tokens_"
@@ -508,6 +559,164 @@ def bench_serve(on_cpu: bool, int8: bool = True, seed: int = 0):
         "mean_interarrival_s": mean_ia,
         "arrival_seed": seed,
         "max_batch": max_batch,
+        "device": jax.devices()[0].device_kind,
+    }
+
+
+def bench_serve_quant(on_cpu: bool, int8: bool = True, seed: int = 0,
+                      model=None):
+    """--serve companion: the quantized-KV record (ROADMAP 3 / ISSUE 14).
+    One seeded request set runs through TWO otherwise-identical engines —
+    ``kv_quant="none"`` (bf16/f32 paged pools) and ``kv_quant="int8"``
+    (int8 pools + per-(token, head) f32 scale pools, dequantized at read
+    time in-kernel) — and the record reports the capacity and fidelity
+    story with its acceptance checks IN-BENCH:
+
+      * at a fixed KV HBM budget the int8 format fits >= 1.8x the pages
+        of the unquantized format (``kv_pages_per_budget_ratio``,
+        computed from the engines' REAL cache leaves — reported
+        ``kv_bytes_per_slot`` roughly halves);
+      * the quantized timed window performs ZERO backend compiles and
+        ZERO serving-jit recompiles (quantize-at-append / dequant-at-
+        read are in-trace data ops — no signature drift; DTL11x holds
+        the same budget on the quant contract entries);
+      * quantized-vs-unquantized token agreement meets the PINNED floor
+        (ops/kv_policy.py:KV_QUANT_TOKEN_AGREEMENT_MIN) — the
+        thresholded parity tier; quantized-vs-quantized bitwise parity
+        is the standing contract pinned by tests/test_kv_quant.py, not
+        re-measured here.
+
+    The recomputed int8 stream roofline rides along: halved sweep bytes
+    raise the kv_sweep_weight_stream_hbm_roofline bound at the
+    sweep-dominated batches (TPU wall numbers pend a device session)."""
+    from dalle_pytorch_tpu.ops import kv_policy
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, Outcome, Request, check_accounting,
+    )
+
+    if model is None:
+        dalle, params, depth, fmap = _serving_model(on_cpu, int8)
+    else:
+        dalle, params = model
+        depth, fmap = dalle.depth, dalle.image_fmap_size
+    rng = np.random.RandomState(seed)
+    n_req = 4 if on_cpu else 16
+    max_new = 4 if on_cpu else fmap * fmap
+    vocab = min(NUM_TEXT, dalle.num_text_tokens)
+    prompts = rng.randint(
+        1, vocab, size=(n_req, dalle.text_seq_len)
+    ).astype(np.int32)
+    chunk = max(2, dalle.text_len_internal // 8)
+
+    def run_engine(kv_quant: str):
+        cfg = EngineConfig(
+            max_batch=2, prefill_chunk=chunk, kv_quant=kv_quant,
+        )
+        engine = Engine(dalle, params, cfg)
+        # warm outside the timed window (compile is not latency)
+        warm = Request(request_id="__warm__",
+                       prompt=np.zeros(dalle.text_seq_len, np.int32),
+                       max_new_tokens=2, seed=0)
+        engine.submit(warm)
+        engine.run(max_steps=20000)
+        sig0, bc0 = serving_jit_signatures(), backend_compiles()
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            engine.submit(Request(
+                request_id=f"q{i}", prompt=prompts[i],
+                max_new_tokens=max_new, seed=seed * 7919 + i,
+            ))
+        engine.run(max_steps=40000)
+        wall = time.perf_counter() - t0
+        sig1, bc1 = serving_jit_signatures(), backend_compiles()
+        check_accounting(engine)
+        toks = {
+            rid: np.asarray(r.tokens)
+            for rid, r in engine.results.items()
+            if r.outcome is Outcome.COMPLETED and rid != "__warm__"
+        }
+        assert len(toks) == n_req, (
+            f"kv_quant={kv_quant}: {len(toks)}/{n_req} completed"
+        )
+        return {
+            "tokens": toks,
+            "wall": wall,
+            "tps": sum(len(t) for t in toks.values()) / wall,
+            "kv_bytes_per_slot": engine.kv_bytes_per_slot,
+            "n_pages_slot": engine.n_pages_slot,
+            "compiles_trace": bc1 - bc0 if bc0 >= 0 else -1,
+            "jit_recompiles_trace": _sig_delta(sig1, sig0),
+        }
+
+    base = run_engine("none")
+    quant = run_engine("int8")
+
+    # capacity at a fixed KV HBM budget, from the REAL cache leaves:
+    # pages the budget buys = budget // bytes-per-page of each format
+    budget = 1 << 30  # 1 GiB of KV pool — any fixed budget, ratio is scale-free
+    bpp_base = base["kv_bytes_per_slot"] / base["n_pages_slot"]
+    bpp_quant = quant["kv_bytes_per_slot"] / quant["n_pages_slot"]
+    pages_base = int(budget // bpp_base)
+    pages_quant = int(budget // bpp_quant)
+    ratio = pages_quant / pages_base
+    assert ratio >= 1.8, (
+        f"int8 KV pages per fixed budget only {ratio:.2f}x the "
+        f"unquantized format (>= 1.8x required)"
+    )
+    assert quant["compiles_trace"] == 0, (
+        f"quantized serving path compiled in-trace: "
+        f"{quant['compiles_trace']}"
+    )
+    assert all(v == 0 for v in quant["jit_recompiles_trace"].values()), (
+        f"quantized serving path re-traced a serving jit: "
+        f"{quant['jit_recompiles_trace']}"
+    )
+
+    # quantized-vs-unquantized token agreement (position-wise fraction,
+    # averaged over requests) against the pinned floor
+    agree = float(np.mean([
+        np.mean(base["tokens"][rid] == quant["tokens"][rid])
+        for rid in base["tokens"]
+    ]))
+    floor = kv_policy.KV_QUANT_TOKEN_AGREEMENT_MIN
+    assert agree >= floor, (
+        f"kv-int8 token agreement {agree:.3f} below the pinned "
+        f"{floor} floor"
+    )
+
+    return {
+        "metric": "serve_kv_quant_int8" + ("_int8w" if int8 else ""),
+        "value": round(ratio, 3),
+        "unit": "pages_per_budget_ratio_int8_vs_unquant",
+        "vs_baseline": None,
+        "kv_quant": "int8",
+        "kv_bytes_per_slot_unquant": base["kv_bytes_per_slot"],
+        "kv_bytes_per_slot_int8": quant["kv_bytes_per_slot"],
+        "kv_pages_per_budget_ratio": round(ratio, 3),
+        "kv_pages_per_budget_unquant": pages_base,
+        "kv_pages_per_budget_int8": pages_quant,
+        "token_agreement_vs_unquant": round(agree, 4),
+        "token_agreement_floor": floor,
+        "completed": len(quant["tokens"]),
+        "n_requests": n_req,
+        "tokens_per_sec_unquant": round(base["tps"], 1),
+        "tokens_per_sec_int8": round(quant["tps"], 1),
+        "cpu_wall_caveat": (
+            "CPU walls measure dispatch overhead, not the HBM stream the "
+            "int8 format halves; TPU numbers pend a device session"
+        ) if on_cpu else None,
+        "compiles_in_trace_int8": quant["compiles_trace"],
+        "jit_recompiles_in_trace_int8": quant["jit_recompiles_trace"],
+        "bound_name": "kv_sweep_weight_stream_hbm_roofline",
+        "roofline_tokens_per_sec_batch8": round(
+            decode_roofline_tokens_per_sec(8, int8=int8, depth=depth,
+                                           fmap=fmap), 1
+        ),
+        "roofline_tokens_per_sec_batch8_kv_int8": round(
+            decode_roofline_tokens_per_sec(8, int8=int8, depth=depth,
+                                           fmap=fmap, kv_quant="int8"), 1
+        ),
+        "arrival_seed": seed,
         "device": jax.devices()[0].device_kind,
     }
 
@@ -2372,6 +2581,7 @@ def main():
             print(json.dumps(_retry(lambda: bench_continuous_batching(on_cpu))))
         if "--serve" in only:
             print(json.dumps(_retry(lambda: bench_serve(on_cpu))))
+            print(json.dumps(_retry(lambda: bench_serve_quant(on_cpu))))
             print(json.dumps(_retry(lambda: bench_serve_fused(on_cpu))))
             print(json.dumps(_retry(lambda: bench_serve_interference(on_cpu))))
             print(json.dumps(_retry(lambda: bench_serve_prefix(on_cpu))))

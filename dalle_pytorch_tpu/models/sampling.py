@@ -45,14 +45,23 @@ def _format_ctx(cache_format: Optional[str]):
 
 
 def init_decode_cache(
-    dalle: DALLE, params, batch_size: int, cache_format: Optional[str] = None
+    dalle: DALLE, params, batch_size: int,
+    cache_format: Optional[str] = None, kv_quant: Optional[str] = None,
 ):
     """Materialize the transformer's KV/shift caches for a batch.
 
-    ``cache_format`` pins the KV layout ("paged" | "flat" | "4d"); None
-    defers to the batch-size policy (ops/kv_policy.py)."""
+    ``cache_format`` pins the KV layout ("paged" | "flat" | "4d");
+    ``kv_quant`` the paged pools' storage quantization ("none" | "int8"
+    — int8 content pools plus parallel per-(token, head) scale pools;
+    ops/kv_policy.py). None defers each to its policy chain. An invalid
+    value for either fails typed at resolution time
+    (``InvalidKVFormatError``)."""
     token = jnp.zeros((batch_size,), dtype=jnp.int32)
-    with _format_ctx(cache_format):
+    quant_ctx = (
+        contextlib.nullcontext() if kv_quant is None
+        else kv_policy.quant_override(kv_policy.resolve_quant(kv_quant))
+    )
+    with _format_ctx(cache_format), quant_ctx:
         _, mutated = dalle.apply(
             {"params": params},
             token,
@@ -347,7 +356,10 @@ def _decode_tokens_body(
                     return jnp.pad(
                         x, [(0, 0), (0, W - x.shape[1])] + [(0, 0)] * (x.ndim - 2)
                     )
-            elif key in ("cached_key_pages", "cached_value_pages"):
+            elif key in paged_kv.POOL_LEAF_KEYS:
+                # content AND scale pools truncate/grow in lockstep on
+                # the page axis (the scale pools are pool-shaped with
+                # feat = heads; ops/paged_kv.py)
                 if x.shape[1] > n_p:
                     return x[:, :n_p]
                 if x.shape[1] < n_p:

@@ -859,30 +859,70 @@ class PatternAttention(nn.Module):
 
     # ------------------------------------------------------- paged decode
 
+    def _kv_quant(self) -> str:
+        """This paged decode call's storage quantization ("none" |
+        "int8"). A SUPPLIED cache's variables win — a cache carrying
+        scale pools IS quantized, one without them is not, so resized /
+        merged / replayed caches keep the format they were built with;
+        with no cache yet, the quant policy decides
+        (ops/kv_policy.py:choose_kv_quant — explicit ``kv_quant=``
+        override context, then DALLE_TPU_KV_QUANT, then "none"). Paged
+        format only: the flat/4d caches never consult this (their
+        single-stream int8 experiment measured SLOWER — the note at the
+        bottom of this file)."""
+        from . import kv_policy
+
+        if self.has_variable("cache", "cached_key_scale_pages"):
+            return "int8"
+        if self.has_variable("cache", "cached_key_pages"):
+            return "none"
+        return kv_policy.choose_kv_quant()
+
     def _paged_caches(self, b, dtype):
         """The block-paged decode cache variables (ops/paged_kv.py): K/V
         page pools (b, n_pages, page, h*d), a per-sequence page table, and
         a PER-SEQUENCE (b,) write index — the only cache format whose index
         can express ragged decode offsets across the batch (continuous
-        batching). Page size comes from kv_policy.page_size()."""
+        batching). Page size comes from kv_policy.page_size().
+
+        Under ``kv_quant="int8"`` (ops/kv_policy.py) the content pools
+        store int8 and two PARALLEL scale pools (b, n_pages, page, h)
+        f32 ride the same page tables — pool-shaped like the content
+        (feat = heads), so every pool primitive (append/gather/
+        copy_pages/copy_pages_across/reset_rows and the prefix-cache
+        arena indirection) covers scales by construction. Returned
+        scale variables are None when unquantized."""
         from . import kv_policy, paged_kv
 
         h, d, L = self.heads, self.dim_head, self.seq_len
         page = kv_policy.page_size()
         n_p = paged_kv.num_pages(L, page)
+        quant = self._kv_quant()
         is_init = not self.has_variable("cache", "cached_key_pages")
+        pool_dtype = jnp.int8 if quant == "int8" else dtype
         pool_shape = (b, n_p, page, h * d)
         k_pool = self.variable(
-            "cache", "cached_key_pages", jnp.zeros, pool_shape, dtype
+            "cache", "cached_key_pages", jnp.zeros, pool_shape, pool_dtype
         )
         v_pool = self.variable(
-            "cache", "cached_value_pages", jnp.zeros, pool_shape, dtype
+            "cache", "cached_value_pages", jnp.zeros, pool_shape, pool_dtype
         )
+        k_scale = v_scale = None
+        if quant == "int8":
+            scale_shape = (b, n_p, page, h)
+            k_scale = self.variable(
+                "cache", "cached_key_scale_pages", jnp.zeros, scale_shape,
+                paged_kv.SCALE_DTYPE,
+            )
+            v_scale = self.variable(
+                "cache", "cached_value_scale_pages", jnp.zeros, scale_shape,
+                paged_kv.SCALE_DTYPE,
+            )
         table = self.variable("cache", "page_table", paged_kv.identity_table, b, n_p)
         cache_index = self.variable(
             "cache", "cache_index", jnp.zeros, (b,), jnp.int32
         )
-        return k_pool, v_pool, table, cache_index, is_init
+        return k_pool, v_pool, k_scale, v_scale, table, cache_index, is_init
 
     def _decode_attend_paged(self, q, k, v, mask, rotary_pos_emb,
                              block_len=None, block_start=None):
@@ -928,9 +968,8 @@ class PatternAttention(nn.Module):
         from . import paged_kv, ragged_attention
 
         b, n, h, d = q.shape
-        k_pool, v_pool, table, cache_index, is_init = self._paged_caches(
-            b, k.dtype
-        )
+        (k_pool, v_pool, k_scale, v_scale, table, cache_index,
+         is_init) = self._paged_caches(b, k.dtype)
         if is_init:
             return jnp.zeros_like(q)
 
@@ -952,13 +991,26 @@ class PatternAttention(nn.Module):
         q = q * (d**-0.5)
 
         hd = h * d
+        k_rows, v_rows = k.reshape(b, n, hd), v.reshape(b, n, hd)
+        if k_scale is not None:
+            # int8 storage: quantize at APPEND time (per-row, per-head
+            # symmetric scales — paged_kv.quantize_rows) and append the
+            # scales to the parallel scale pools through the SAME table/
+            # index/limit, so bytes and scales can never go out of step
+            # (the spec-decode rewind overwrites both identically)
+            k_rows, k_s = paged_kv.quantize_rows(k_rows, h)
+            v_rows, v_s = paged_kv.quantize_rows(v_rows, h)
+            k_scale.value = paged_kv.append(
+                k_scale.value, table.value, idx, k_s, limit=block_len
+            )
+            v_scale.value = paged_kv.append(
+                v_scale.value, table.value, idx, v_s, limit=block_len
+            )
         k_pool.value = paged_kv.append(
-            k_pool.value, table.value, idx, k.reshape(b, n, hd),
-            limit=block_len,
+            k_pool.value, table.value, idx, k_rows, limit=block_len,
         )
         v_pool.value = paged_kv.append(
-            v_pool.value, table.value, idx, v.reshape(b, n, hd),
-            limit=block_len,
+            v_pool.value, table.value, idx, v_rows, limit=block_len,
         )
         if block_start is not None:
             # idle rows (block_len 0) carry garbage descriptors; their
@@ -977,10 +1029,23 @@ class PatternAttention(nn.Module):
             return ragged_attention.kernel_attend(
                 q, k_pool.value, v_pool.value, table.value, idx, block_len,
                 interpret=jax.devices()[0].platform != "tpu",
+                k_scales=None if k_scale is None else k_scale.value,
+                v_scales=None if v_scale is None else v_scale.value,
             )
 
         k_cache = paged_kv.gather(k_pool.value, table.value)  # (b, W, h*d)
         v_cache = paged_kv.gather(v_pool.value, table.value)
+        if k_scale is not None:
+            # read-time dequant of the gathered view: the ONE shared
+            # formula (paged_kv.dequant) the Pallas kernel also
+            # implements per page, widened back to the compute dtype
+            # before the shared attention core
+            k_cache = paged_kv.dequant(
+                k_cache, paged_kv.gather(k_scale.value, table.value), k.dtype
+            )
+            v_cache = paged_kv.dequant(
+                v_cache, paged_kv.gather(v_scale.value, table.value), v.dtype
+            )
         W = k_cache.shape[1]
 
         pm = jnp.asarray(self.pattern_mask())  # (L, L)
@@ -1067,9 +1132,16 @@ class PatternAttention(nn.Module):
     # on the serial op chain, not HBM-bound: the ~31 MB/step the int8 cache
     # saves is worth ~40 us at HBM bandwidth, while the extra quantize /
     # dequantize elementwise stages add more serial work than that to every
-    # one of the 1024 steps. The caches therefore stay bf16; int8 serving
-    # quantizes what decode is actually bound on — the weight matrices and
-    # embedding tables (utils/quantize.py).
+    # one of the 1024 steps. The flat/4d caches therefore stay bf16; int8
+    # serving quantizes what decode is actually bound on — the weight
+    # matrices and embedding tables (utils/quantize.py). The PAGED serving
+    # pools are the different regime that negative result does NOT cover:
+    # the engine's batched pools are the largest HBM tenant of a
+    # throughput-bound fleet (capacity, prefix-cache arena, and the
+    # streamed-page kernel all scale with KV bytes), so they get an
+    # opt-in int8 storage format with per-(token, head) scales behind
+    # kv_policy.choose_kv_quant — see _paged_caches/_kv_quant above and
+    # docs/DESIGN.md §6.1; TPU wall numbers pend a device session.
     #
     # Round-5 serial-chain attack (measured, v5e-1, 2026-07): the "head +
     # sampling the rest" slice of the accounting above was mostly NOT the

@@ -58,6 +58,51 @@ FORMATS = ("paged", "flat", "4d")
 
 DEFAULT_PAGE_SIZE = 128
 
+# ------------------------------------------------------------ KV quant
+#
+# Storage quantization of the PAGED pools (ops/paged_kv.py): "int8"
+# stores K/V pages as int8 with per-(token, head) symmetric scales in a
+# parallel paged scale pool, quantized at append time and dequantized at
+# READ time in-kernel — in the Pallas ragged path the int8 pages stream
+# through VMEM and widen in registers (ops/ragged_attention.py), in the
+# jnp reference path the gathered view dequantizes through the same
+# formula (paged_kv.dequant), so the two paths cannot drift. The knob is
+# orthogonal to the layout FORMAT above and applies to the paged format
+# only (the flat/4d decode caches never consulted it — their one
+# measured int8 experiment LOST on single-stream latency; see the
+# measured note at the bottom of ops/attention.py. The serving engine's
+# batched paged pools are a different regime: the largest HBM tenant
+# under a stream-bound roofline, where halved bytes mean ~2x slots and
+# ~2x prefix-cache arena at fixed HBM).
+#
+# Override channels, strongest first (mirroring the format channels; an
+# invalid value fails TYPED at resolution time in every one of them):
+# - ``quant_override(q)`` context manager (how an explicit ``kv_quant=``
+#   argument — models/sampling.py:init_decode_cache, EngineConfig —
+#   reaches the attention layers at trace time);
+# - ``DALLE_TPU_KV_QUANT`` = none|int8;
+# - default policy: "none".
+#
+# Parity tiers (docs/DESIGN.md §6.1): quantized-vs-quantized holds the
+# standing BITWISE contract everywhere (cold vs warm prefix hit, split
+# vs fused engines, preempt replay, spec decode) — quantization is a
+# deterministic per-row elementwise map, so the PR 9/10/11 parity
+# arguments carry over unchanged. Quantized-vs-f32 is a pinned
+# token-AGREEMENT threshold (below), asserted in tests and reported by
+# bench.py --serve; it is never a bitwise claim.
+
+QUANTS = ("none", "int8")
+
+# pinned quantized-vs-f32 token-agreement floor (fraction of generated
+# positions whose sampled token matches the unquantized run, same seed):
+# asserted by tests/test_kv_quant.py and tools/serve_smoke.py, reported
+# by bench.py --serve. Position-wise agreement is chance-level after a
+# first divergence, so the floor is deliberately below the typically
+# observed ~1.0 on the tiny f32 CPU tier — it guards against the
+# quantizer breaking (agreement collapsing toward the random-token
+# floor), not against single near-tie sample flips.
+KV_QUANT_TOKEN_AGREEMENT_MIN = 0.5
+
 
 class InvalidKVFormatError(ValueError):
     """Raised at POLICY-RESOLUTION time for an unknown cache format (from
@@ -84,6 +129,10 @@ _EMITTED: set = set()
 # must not see each other's override
 _OVERRIDE: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     "dalle_tpu_kv_format_override", default=None
+)
+
+_QUANT_OVERRIDE: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "dalle_tpu_kv_quant_override", default=None
 )
 
 
@@ -182,3 +231,63 @@ def resolve_format(cache_format: Optional[str], batch: int) -> str:
         _emit(cache_format, batch, "cache_format argument")
         return cache_format
     return choose_cache_format(batch)
+
+
+# ------------------------------------------------------------ KV quant
+
+
+@contextlib.contextmanager
+def quant_override(quant: Optional[str]) -> Iterator[None]:
+    """Pin the KV storage quantization for every ``choose_kv_quant`` call
+    in the block — the trace-time channel for an explicit ``kv_quant=``
+    argument (models/sampling.py:init_decode_cache wraps its traced body
+    in this, so the serving engine's caches can never drift from the
+    ambient environment between the batched cache and its prefill
+    template)."""
+    if quant is not None and quant not in QUANTS:
+        raise InvalidKVFormatError("kv_quant", quant, valid=QUANTS)
+    token = _QUANT_OVERRIDE.set(quant)
+    try:
+        yield
+    finally:
+        _QUANT_OVERRIDE.reset(token)
+
+
+def choose_kv_quant() -> str:
+    """Resolve the paged-pool storage quantization ("none" | "int8") —
+    called at trace time by ops/attention.py when no cache exists yet (a
+    SUPPLIED cache's variables win there, exactly like the layout
+    format). Channel order and error typing mirror
+    ``choose_cache_format``; see the KV-quant block in the module
+    docstring area above for the policy rationale."""
+    override = _QUANT_OVERRIDE.get()
+    if override is not None:
+        quant, reason = override, "explicit override"
+    else:
+        env = os.environ.get("DALLE_TPU_KV_QUANT")
+        if env not in (None, ""):
+            if env not in QUANTS:
+                raise InvalidKVFormatError(
+                    "DALLE_TPU_KV_QUANT", env, valid=QUANTS
+                )
+            quant, reason = env, "DALLE_TPU_KV_QUANT"
+        else:
+            quant, reason = "none", "policy: default unquantized"
+    key = ("kv_quant", quant, reason)
+    if key not in _EMITTED:
+        _EMITTED.add(key)
+        logger.info("decode KV quantization: %s (%s)", quant, reason)
+    return quant
+
+
+def resolve_quant(kv_quant: Optional[str]) -> str:
+    """An explicit ``kv_quant`` argument wins; ``None`` defers to the
+    override/env/policy chain. Entry point for
+    models/sampling.py:init_decode_cache and the serving EngineConfig —
+    an invalid value fails TYPED here, at resolution time, naming the
+    valid quants (never as a dtype error deep inside cache init)."""
+    if kv_quant is not None:
+        if kv_quant not in QUANTS:
+            raise InvalidKVFormatError("kv_quant", kv_quant, valid=QUANTS)
+        return kv_quant
+    return choose_kv_quant()

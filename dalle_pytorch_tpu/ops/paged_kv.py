@@ -71,6 +71,67 @@ import jax.numpy as jnp
 
 from .kv_policy import DEFAULT_PAGE_SIZE
 
+# Every cache-tree leaf name that is POOL-SHAPED — (rows, n_pages, page,
+# feat) storage addressed by global page ids. The serving engine's
+# generic pool machinery (arena append, publish/COW/restore page copies,
+# eviction resets, snapshot leaf enumeration) pattern-matches on THIS
+# tuple, so a new pool kind (the int8 scale pools) rides every seam by
+# construction instead of by N hand-updated name lists. CONTENT_KEYS are
+# the K/V byte pools; SCALE_KEYS the parallel per-(token, head) scale
+# pools that exist only under kv_quant="int8" (ops/kv_policy.py).
+CONTENT_KEYS = ("cached_key_pages", "cached_value_pages")
+SCALE_KEYS = ("cached_key_scale_pages", "cached_value_scale_pages")
+POOL_LEAF_KEYS = CONTENT_KEYS + SCALE_KEYS
+
+# dtype of the per-(token, head) scales — f32, like every QuantDense /
+# QuantEmbed scale in ops/layers.py (the repo's one quant idiom)
+SCALE_DTYPE = jnp.float32
+
+
+def quantize_rows(rows: jnp.ndarray, heads: int):
+    """Symmetric int8 quantization of K/V rows at APPEND time: ``rows``
+    (b, n, heads * d) float -> (int8 rows (b, n, heads * d), f32 scales
+    (b, n, heads)). Per-(token, head) granularity: each appended row
+    owns its scale, stored in the parallel paged scale pool, so an
+    append is position-local and IDEMPOTENT — re-appending the same row
+    (preempt replay, the spec-decode reject-suffix overwrite) reproduces
+    byte-identical pool content, which is what keeps every standing
+    bitwise parity contract intact under quantization. (A literal
+    one-scale-per-page scheme would need requantization as the page
+    fills, breaking exactly that idempotence.) The arithmetic mirrors
+    utils/quantize.py:quantize_kernel: amax/127 scale, zeros quantize
+    with scale 1, round-to-nearest-even, clip to [-127, 127]."""
+    b, n, hd = rows.shape
+    d = hd // heads
+    assert heads * d == hd, (rows.shape, heads)
+    r = rows.astype(jnp.float32).reshape(b, n, heads, d)
+    amax = jnp.max(jnp.abs(r), axis=-1)  # (b, n, heads)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(SCALE_DTYPE)
+    q = jnp.clip(
+        jnp.round(r / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q.reshape(b, n, hd), scale
+
+
+def dequant(view: jnp.ndarray, scales: jnp.ndarray, dtype) -> jnp.ndarray:
+    """THE dequantization formula, shared verbatim by the jnp reference
+    path (gathered (b, W, h*d) int8 view + gathered (b, W, h) scales —
+    ops/ragged_attention.py:reference_attend and the split decode path)
+    and semantically by the Pallas kernel's in-register widen (same
+    int8->f32 widen, same f32 scale multiply, per page instead of per
+    view — ops/ragged_attention.py:_ragged_kernel). int8 values are
+    exact in f32 and the scale multiply happens in f32 before the cast
+    to the compute ``dtype``, so the formula is deterministic
+    elementwise: identical pool bytes always dequantize to identical
+    values, the keystone of the quantized bitwise-parity tier."""
+    b, W, hd = view.shape
+    h = scales.shape[-1]
+    d = hd // h
+    x = view.astype(jnp.float32).reshape(b, W, h, d) * (
+        scales.astype(jnp.float32)[..., None]
+    )
+    return x.reshape(b, W, hd).astype(dtype)
+
 
 def gather_variant() -> str:
     """``take`` (default) or ``onehot`` — see the measured comparison in the

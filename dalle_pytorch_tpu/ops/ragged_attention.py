@@ -82,18 +82,31 @@ def use_kernel(causal_full: bool, has_key_mask: bool) -> bool:
 # ------------------------------------------------------------- reference
 
 
-def reference_attend(q, k_pool, v_pool, table, allowed, stable=False):
+def reference_attend(q, k_pool, v_pool, table, allowed, stable=False,
+                     k_scales=None, v_scales=None):
     """The jnp oracle: gather the paged pools into the logical cache view
     and run the ONE shared masked-block attention. q (b, n, h, d)
     pre-scaled (rotary already applied); pools (b, n_p, page, h*d);
     ``allowed`` broadcastable to (b, 1, n, W_cache). Bitwise identical to
     the split paths' attention core by construction — both are
-    ``cache_block_attend`` on the same gathered view."""
+    ``cache_block_attend`` on the same gathered view. Quantized pools
+    (int8 content + parallel (b, n_p, page, h) scale pools; ``k_scales``
+    / ``v_scales``) dequantize the gathered view through the ONE shared
+    formula (``paged_kv.dequant``) before the attention core — the same
+    gather + dequant the split decode path runs, so fused-vs-split
+    bitwise parity survives quantization unchanged."""
     from . import paged_kv
     from .attention import cache_block_attend
 
     k_cache = paged_kv.gather(k_pool, table)  # (b, W, h*d)
     v_cache = paged_kv.gather(v_pool, table)
+    if k_scales is not None:
+        k_cache = paged_kv.dequant(
+            k_cache, paged_kv.gather(k_scales, table), q.dtype
+        )
+        v_cache = paged_kv.dequant(
+            v_cache, paged_kv.gather(v_scales, table), q.dtype
+        )
     return cache_block_attend(q, k_cache, v_cache, allowed, stable)
 
 
@@ -101,8 +114,8 @@ def reference_attend(q, k_pool, v_pool, table, allowed, stable=False):
 
 
 def _ragged_kernel(
-    scalar_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-    *, heads, dim_head, page, n_pages, width,
+    scalar_ref, q_ref, k_ref, v_ref, *refs,
+    heads, dim_head, page, n_pages, width, quant,
 ):
     """One (row, page) grid step: q_ref (1, W, h*d) is row b's whole
     padded block, k_ref/v_ref (1, page, h*d) one physical page of the
@@ -114,7 +127,20 @@ def _ragged_kernel(
     ``start`` descriptor; pages past the row's frontier skip compute
     (their DMA still streams — affine-in-j index maps keep Mosaic's
     pipeline; the skipped page's bytes are the price of raggedness-as-
-    data)."""
+    data).
+
+    ``quant``: int8 pages with parallel per-(token, head) scale pages
+    (ks_ref/vs_ref, (1, page, h) f32, selected by the SAME table entry
+    so a shared prefix-arena page brings its own scales). Dequantization
+    is IN-KERNEL, fused with the page stream: the int8 block widens to
+    f32 in registers and multiplies its scale column before the dots —
+    the same int8->f32-widen * f32-scale formula as ``paged_kv.dequant``
+    — so the kernel streams half the KV bytes per page (plus the small
+    h/(h*d) scale stream) and never materializes a dequantized cache."""
+    if quant:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
     b_i, j = pl.program_id(0), pl.program_id(1)
     start = scalar_ref[b_i, n_pages]
     # frontier: the highest position this block can attend is its own
@@ -141,6 +167,20 @@ def _ragged_kernel(
             qh = q_ref[0, :, lo:lo + dim_head]              # (W, d)
             kh = k_ref[0, :, lo:lo + dim_head]              # (page, d)
             vh = v_ref[0, :, lo:lo + dim_head]
+            if quant:
+                # in-register widen + scale: the shared dequant formula
+                # (paged_kv.dequant) applied to one streamed page —
+                # INCLUDING its final cast to the compute dtype, so the
+                # kernel sees the same rounded K/V values the reference
+                # path's gathered-view dequant produces (on a bf16
+                # compute tier an uncast f32 product would diverge from
+                # the split path in low bits; f32 tiers are unaffected)
+                kh = (
+                    kh.astype(jnp.float32) * ks_ref[0, :, h_:h_ + 1]
+                ).astype(o_ref.dtype)
+                vh = (
+                    vh.astype(jnp.float32) * vs_ref[0, :, h_:h_ + 1]
+                ).astype(o_ref.dtype)
             s = jax.lax.dot_general(
                 qh, kh, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -169,18 +209,28 @@ def _ragged_kernel(
             ).astype(o_ref.dtype)
 
 
-def kernel_attend(q, k_pool, v_pool, table, start, length, interpret=False):
+def kernel_attend(q, k_pool, v_pool, table, start, length, interpret=False,
+                  k_scales=None, v_scales=None):
     """Pallas ragged paged attention, causal-"full" masking. q (b, n, h, d)
     pre-scaled; returns (b, n, h, d). The pools are streamed through their
     FLATTENED (rows * n_pages, page, h*d) global view — the id space the
     table indexes (ops/paged_kv.py) — so pools carrying prefix-cache arena
-    rows beyond the query batch work unchanged. See the kernel docstring."""
+    rows beyond the query batch work unchanged. ``k_scales``/``v_scales``
+    (both or neither): int8 pools with parallel (b, n_p, page, h) f32
+    scale pools — two more streamed operands riding the SAME table
+    dereference, dequantized in-kernel (see the kernel docstring). The
+    scale blocks' h-lane minor dim under-fills the 128-lane tile for
+    small head counts (VMEM padding, not HBM traffic); a bitcast-packed
+    scales-in-page layout is the known upgrade if a TPU profile shows
+    the scale stream mattering next to the halved KV bytes."""
     from . import paged_kv
 
     b, n, h, d = q.shape
     _, n_p, page, hd = k_pool.shape
     l_pages = table.shape[1]
     assert hd == h * d, (k_pool.shape, (h, d))
+    quant = k_scales is not None
+    assert (k_scales is None) == (v_scales is None)
     qf = q.reshape(b, n, hd)
     k_flat = paged_kv.flat_view(k_pool)
     v_flat = paged_kv.flat_view(v_pool)
@@ -194,27 +244,39 @@ def kernel_attend(q, k_pool, v_pool, table, start, length, interpret=False):
 
     kernel = functools.partial(
         _ragged_kernel, heads=h, dim_head=d, page=page, n_pages=l_pages,
-        width=n,
+        width=n, quant=quant,
     )
+    # the page-table indirection: grid step (bi, j) streams PHYSICAL
+    # page table[bi, j] of the flat view — possibly another row's
+    # storage or a shared prefix-cache arena page
+    # (serving/prefix_cache.py); each grid step still fetches a
+    # distinct page, preserving DMA pipelining
+    page_spec = pl.BlockSpec((1, page, hd), lambda bi, j, s: (s[bi, j], 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, n, hd), lambda bi, j, s: (bi, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [scalar, qf, k_flat, v_flat]
+    kv_bytes = b * l_pages * page * hd * 2 * k_pool.dtype.itemsize
+    if quant:
+        # scale pages ride the same indirection as their content pages
+        scale_spec = pl.BlockSpec(
+            (1, page, h), lambda bi, j, s: (s[bi, j], 0, 0)
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [
+            paged_kv.flat_view(k_scales), paged_kv.flat_view(v_scales),
+        ]
+        kv_bytes += (
+            b * l_pages * page * h * 2 * k_scales.dtype.itemsize
+        )
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, l_pages),
-            in_specs=[
-                pl.BlockSpec((1, n, hd), lambda bi, j, s: (bi, 0, 0)),
-                # the page-table indirection: grid step (bi, j) streams
-                # PHYSICAL page table[bi, j] of the flat view — possibly
-                # another row's storage or a shared prefix-cache arena
-                # page (serving/prefix_cache.py); each grid step still
-                # fetches a distinct page, preserving DMA pipelining
-                pl.BlockSpec(
-                    (1, page, hd), lambda bi, j, s: (s[bi, j], 0, 0)
-                ),
-                pl.BlockSpec(
-                    (1, page, hd), lambda bi, j, s: (s[bi, j], 0, 0)
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, n, hd), lambda bi, j, s: (bi, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((h, n, LANES), jnp.float32),
@@ -230,13 +292,10 @@ def kernel_attend(q, k_pool, v_pool, table, start, length, interpret=False):
         cost_estimate=pl.CostEstimate(
             flops=2 * b * h * n * l_pages * page * d * 2,
             transcendentals=b * h * n * l_pages * page,
-            bytes_accessed=(
-                b * l_pages * page * hd * 2 * k_pool.dtype.itemsize
-                + 2 * b * n * hd * q.dtype.itemsize
-            ),
+            bytes_accessed=kv_bytes + 2 * b * n * hd * q.dtype.itemsize,
         ),
         interpret=interpret,
-    )(scalar, qf, k_flat, v_flat)
+    )(*operands)
     return out.reshape(b, n, h, d)
 
 

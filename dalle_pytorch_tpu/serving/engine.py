@@ -101,6 +101,7 @@ and falls back to the scan only for engine-unsupported models.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import json
 import shutil
 from dataclasses import dataclass
@@ -223,6 +224,21 @@ class EngineConfig:
     # arena capacity in pages; rounded UP to whole storage rows. None =
     # four prompts' worth (a few distinct templates stay resident).
     prefix_cache_pages: Optional[int] = None
+    # paged-KV storage quantization (ops/kv_policy.py QUANTS): "int8"
+    # stores the K/V page pools as int8 with parallel per-(token, head)
+    # f32 scale pools, quantized at append and dequantized at read
+    # in-kernel (Pallas ragged path) / in the shared jnp formula
+    # (paged_kv.dequant) — roughly HALVING the engine's largest HBM
+    # tenant: ~2x concurrent slots per chip at fixed budget, ~2x
+    # prefix-cache arena working set, and a faster streamed-page decode
+    # under the kv_sweep_weight_stream_hbm_roofline bound (BENCH_r01).
+    # Parity tiers: quantized-vs-quantized holds the standing BITWISE
+    # contract (cold/warm hit, split/fused, preempt replay, spec
+    # decode); quantized-vs-f32 is the pinned token-agreement threshold
+    # (kv_policy.KV_QUANT_TOKEN_AGREEMENT_MIN), never a bitwise claim.
+    # None defers to DALLE_TPU_KV_QUANT / the "none" default; an
+    # invalid value fails typed at Engine construction.
+    kv_quant: Optional[str] = None
 
 
 _PREFILL = "prefill"
@@ -622,9 +638,7 @@ def _copy_pages_jit(cache, src, dst, valid):
     the copy happens in the pool's own buffers, never double-buffering
     it on the host path."""
     def fn(path, x):
-        if getattr(path[-1], "key", None) in (
-            "cached_key_pages", "cached_value_pages"
-        ):
+        if getattr(path[-1], "key", None) in paged_kv.POOL_LEAF_KEYS:
             return paged_kv.copy_pages(x, src, dst, valid)
         return x
 
@@ -639,9 +653,7 @@ def _copy_pages_across_jit(dst_cache, src_cache, src, dst, valid):
     reach the batched storage). Same fixed padded shape, destination
     cache donated; registry entry ``serving.page_copy_across``."""
     def fn(path, x1, xb):
-        if getattr(path[-1], "key", None) in (
-            "cached_key_pages", "cached_value_pages"
-        ):
+        if getattr(path[-1], "key", None) in paged_kv.POOL_LEAF_KEYS:
             return paged_kv.copy_pages_across(x1, xb, src, dst, valid)
         return x1
 
@@ -659,9 +671,7 @@ def _append_arena_rows(cache, rows: int):
         return cache
 
     def fn(path, x):
-        if getattr(path[-1], "key", None) in (
-            "cached_key_pages", "cached_value_pages"
-        ):
+        if getattr(path[-1], "key", None) in paged_kv.POOL_LEAF_KEYS:
             return jnp.pad(x, [(0, rows)] + [(0, 0)] * (x.ndim - 1))
         return x
 
@@ -700,6 +710,29 @@ def _snap_unpack(packed: np.ndarray, dtype_name: str) -> jnp.ndarray:
     return jnp.asarray(
         np.ascontiguousarray(packed).view(np.dtype(dtype_name))
     )
+
+
+def _node_content_digest(arrays: Dict[str, np.ndarray], i: int,
+                         n_leaves: int, n_ring: int, rec: dict) -> str:
+    """sha256 over node ``i``'s PERSISTED payload bytes — its page row
+    in every pool leaf (K/V content AND, under kv_quant, the scale
+    pools), its ring-seam arrays, and its terminal logits, all in the
+    packed (uint8) representation that lands on disk. The chain digest
+    covers the node's MEANING (tokens, under the format-salted root);
+    this covers its stored REPRESENTATION, so a re-manifested tamper of
+    ``arrays.npz`` — page bytes or scales flipped, manifest regenerated
+    — fails verify-on-load typed instead of serving forged K/V warm.
+    Computed by save and recomputed by load from the same packed
+    arrays."""
+    hasher = hashlib.sha256()
+    for j in range(n_leaves):
+        hasher.update(np.ascontiguousarray(arrays[f"pages_l{j}"][i]))
+    if rec.get("has_ring"):
+        for k in range(n_ring):
+            hasher.update(np.ascontiguousarray(arrays[f"ring{i}_{k}"]))
+    if rec.get("has_logits"):
+        hasher.update(np.ascontiguousarray(arrays[f"logits{i}"]))
+    return hasher.hexdigest()
 
 
 def _ring_snapshot(cache, row: int) -> dict:
@@ -778,6 +811,12 @@ class Engine:
         self.page = kv_policy.page_size()
         self.T = dalle.text_len_internal
         self.n_pages_slot = pages_for(self.T + dalle.image_seq_len, self.page)
+        # paged-KV storage quantization, resolved ONCE and pinned for
+        # every cache this engine builds (the batched cache, the prefill
+        # template, and therefore every jit signature) — an invalid
+        # config value fails typed here, and ambient env drift after
+        # construction cannot desynchronize the engine's caches
+        self.kv_quant = kv_policy.resolve_quant(config.kv_quant)
         # prefix-cache arena sizing: whole storage ROWS appended to the
         # batched pools (global ids keep the identity stride == the
         # table width; ops/paged_kv.py), so requested pages round up
@@ -815,19 +854,28 @@ class Engine:
         B = config.max_batch
         # fixed-slot batched cache; every index leaf vectorized once
         self.cache = set_decode_offsets(
-            init_decode_cache(dalle, params, B, cache_format="paged"),
+            init_decode_cache(
+                dalle, params, B, cache_format="paged",
+                kv_quant=self.kv_quant,
+            ),
             jnp.zeros((B,), jnp.int32),
         )
         # prefix cache: arena rows appended to the POOL leaves only (page
         # tables/indices stay B-wide — arena pages are reachable purely
         # through remapped table entries), plus the host-side index over
-        # the arena's global page-id range
+        # the arena's global page-id range. The index's chain root is
+        # salted with this engine's KV-format tag so content hashes
+        # cover the stored representation — quantized bytes + scales —
+        # not just the tokens (prefix_cache.chain_root).
         self.prefix: Optional[PrefixCache] = None
         if config.prefix_cache:
             self.cache = _append_arena_rows(self.cache, self._arena_rows)
             n_p = self.n_pages_slot
             arena_ids = range(B * n_p, (B + self._arena_rows) * n_p)
-            self.prefix = PrefixCache(list(arena_ids), self.page)
+            self.prefix = PrefixCache(
+                list(arena_ids), self.page,
+                format_tag=self._kv_format_tag(),
+            )
         self._prefix_hits = 0
         self._prefix_misses = 0
         # pristine batch-1 cache, the TEMPLATE every prefill starts from.
@@ -837,7 +885,10 @@ class Engine:
         # jit a private copy (one small memcpy per admission vs
         # double-buffering the cache for every prefill call).
         self._fresh1 = set_decode_offsets(
-            init_decode_cache(dalle, params, 1, cache_format="paged"),
+            init_decode_cache(
+                dalle, params, 1, cache_format="paged",
+                kv_quant=self.kv_quant,
+            ),
             jnp.zeros((1,), jnp.int32),
         )
         self.slots: List[Optional[_Slot]] = [None] * B
@@ -910,6 +961,44 @@ class Engine:
         # chunk plus one decode step
         self.dispatches = 0
         self.iterations = 0
+        # KV footprint accounting (the quantized-KV capacity lever,
+        # docs/DESIGN.md §6.1): bytes of K/V storage — content AND
+        # scale pools — per slot row, computed from the REAL cache
+        # leaves so the reported number can never drift from what the
+        # engine allocates. Published once here and re-published with
+        # the other gauges each iteration (serve.kv_quant.* names).
+        self.kv_bytes_per_slot = sum(
+            int(np.prod(x.shape[1:])) * x.dtype.itemsize
+            for _, x in self._pool_leaf_paths()
+        )
+        self._total_pool_pages = (
+            (config.max_batch + self._arena_rows) * self.n_pages_slot
+        )
+        self._publish_kv_gauges()
+
+    def _kv_format_tag(self) -> bytes:
+        """This engine's KV storage-format descriptor: quantization,
+        page size, and the pool/scale leaf dtypes — the prefix chain's
+        root salt and the snapshot compatibility key. Derived from the
+        REAL cache leaves, so the tag tracks the code's storage choices,
+        never a transcription of them. The default unquantized format
+        keeps the empty (pre-quantization) tag for snapshot continuity."""
+        if self.kv_quant == "none":
+            return b""
+        dts = sorted({
+            np.dtype(x.dtype).name for _, x in self._pool_leaf_paths()
+        })
+        return (
+            f"kv:{self.kv_quant}:page{self.page}:{','.join(dts)}".encode()
+        )
+
+    def _publish_kv_gauges(self) -> None:
+        self.gauges.set(
+            "serve.kv_quant.bytes_per_slot", float(self.kv_bytes_per_slot)
+        )
+        self.gauges.set(
+            "serve.kv_quant.pages", float(self._total_pool_pages)
+        )
 
     # ------------------------------------------------------------ public
 
@@ -1464,9 +1553,7 @@ class Engine:
         the stable leaf enumeration the snapshot format keys on."""
         out = []
         for path, x in jax.tree_util.tree_leaves_with_path(self.cache):
-            if getattr(path[-1], "key", None) in (
-                "cached_key_pages", "cached_value_pages"
-            ):
+            if getattr(path[-1], "key", None) in paged_kv.POOL_LEAF_KEYS:
                 out.append((jax.tree_util.keystr(path), x))
         return sorted(out, key=lambda kv: kv[0])
 
@@ -1530,11 +1617,20 @@ class Engine:
                 arrays[f"logits{i}"], dtypes[f"logits{i}"] = _snap_pack(
                     node.logits
                 )
+        for i, rec in enumerate(records):
+            rec["content_sha256"] = _node_content_digest(
+                arrays, i, len(leaves), len(ring_paths), rec
+            )
         index = {
             "format": 1,
             "page_size": self.page,
             "T": self.T,
             "n_pages_slot": n_p,
+            # the KV storage-format tag: the chain digests above were
+            # derived under this root salt, and a restore into an engine
+            # of a DIFFERENT storage format (quantized vs not, other
+            # dtypes) must reject typed before any bytes land
+            "kv_format": self._kv_format_tag().decode(),
             "leaf_paths": [k for k, _ in leaves],
             "ring_paths": ring_paths,
             "dtypes": dtypes,
@@ -1615,6 +1711,16 @@ class Engine:
                 f"(page={index.get('page_size')}, T={index.get('T')}) vs "
                 f"engine (page={self.page}, T={self.T})"
             )
+        tag = self._kv_format_tag().decode()
+        if index.get("kv_format", "") != tag:
+            # a cross-format restore (quantized snapshot into an f32
+            # engine or vice versa) would cast foreign bytes into place
+            # as "verified" warm K/V — and its chain digests live under
+            # a different root salt anyway (prefix_cache.chain_root)
+            return self._reject_snapshot(
+                f"kv format mismatch: snapshot "
+                f"{index.get('kv_format', '')!r} vs engine {tag!r}"
+            )
         if index.get("leaf_paths") != [k for k, _ in leaves]:
             return self._reject_snapshot("cache leaf paths differ")
         for j, (keystr, x) in enumerate(leaves):
@@ -1629,7 +1735,9 @@ class Engine:
                     f"cache dtype mismatch at {keystr}: snapshot "
                     f"{want} vs engine {have}"
                 )
-        ok, reason = verify_snapshot_records(records, self.page)
+        ok, reason = verify_snapshot_records(
+            records, self.page, format_tag=self._kv_format_tag()
+        )
         if not ok:
             return self._reject_snapshot(reason)
         # every payload the build phase will dereference must exist with
@@ -1654,6 +1762,20 @@ class Engine:
             ):
                 return self._reject_snapshot(
                     f"record {i}: logits payload missing from arrays"
+                )
+        # content digests: the chain digest (above) covers each node's
+        # MEANING; this covers its stored REPRESENTATION — quantized
+        # page bytes, scales, ring seams, logits — so arrays.npz cannot
+        # be tampered behind a regenerated manifest
+        for i, rec in enumerate(records):
+            want = rec.get("content_sha256")
+            have = _node_content_digest(
+                arrays, i, len(leaves), len(ring_paths), rec
+            )
+            if want != have:
+                return self._reject_snapshot(
+                    f"record {i}: page content digest mismatch "
+                    "(tampered or missing payload bytes)"
                 )
         if len(records) > self.prefix.free_arena_pages:
             return self._reject_snapshot(
@@ -2788,7 +2910,7 @@ class Engine:
 
         def fn(path, x):
             key = getattr(path[-1], "key", None)
-            if key in ("cached_key_pages", "cached_value_pages"):
+            if key in paged_kv.POOL_LEAF_KEYS:
                 return paged_kv.reset_rows(x, idx)
             if key == "page_table":
                 return paged_kv.reset_table_rows(x, idx)
@@ -2944,6 +3066,7 @@ class Engine:
         )
 
     def _publish_gauges(self) -> None:
+        self._publish_kv_gauges()
         self.gauges.set("serve.pool_occupancy", self.pool.occupancy)
         self.gauges.set(
             "serve.running",

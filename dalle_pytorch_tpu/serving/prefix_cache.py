@@ -56,6 +56,23 @@ from ..utils.faults import FAULTS
 _ROOT = b"prefix-cache-root"
 
 
+def chain_root(format_tag: bytes = b"") -> bytes:
+    """The chain's root parent digest. A non-empty ``format_tag`` (the
+    engine's KV storage-format descriptor: quantization, pool/scale
+    dtypes, page size) SALTS the root, so every digest in the chain
+    addresses (KV format, tokens) — page content is a deterministic
+    function of exactly that pair, which is how the content hash COVERS
+    the quantized bytes + scales without syncing device arrays into a
+    hasher on the publish path. Two engines with different KV formats
+    therefore can never exchange chain addresses (a quantized snapshot
+    offered to an f32 engine misses at the root, before the leaf-dtype
+    checks even run). The empty tag preserves the pre-quantization
+    address space for the default unquantized format."""
+    if not format_tag:
+        return _ROOT
+    return hashlib.sha1(_ROOT + format_tag).digest()
+
+
 def chain_blocks(tokens: np.ndarray, page_size: int) -> List[np.ndarray]:
     """Cut a prompt's internal token row into its chain blocks: full
     ``page_size`` blocks plus one terminal partial block ending at T
@@ -71,13 +88,15 @@ def _digest(parent: bytes, block: np.ndarray) -> bytes:
     ).digest()
 
 
-def chain_digest(parent: Optional[bytes], block: np.ndarray) -> bytes:
-    """Public chain-digest derivation (``parent=None`` = chain root) —
-    shared by the index itself and the snapshot verifier, so a persisted
-    node's address can be recomputed from its tokens and checked against
-    what was stored (verify-on-load is mandatory: the hash is an
-    address, never a proof; docs/DESIGN.md §8.3)."""
-    return _digest(_ROOT if parent is None else parent, block)
+def chain_digest(parent: Optional[bytes], block: np.ndarray,
+                 format_tag: bytes = b"") -> bytes:
+    """Public chain-digest derivation (``parent=None`` = chain root,
+    salted by ``format_tag`` — see ``chain_root``) — shared by the index
+    itself and the snapshot verifier, so a persisted node's address can
+    be recomputed from its tokens and checked against what was stored
+    (verify-on-load is mandatory: the hash is an address, never a
+    proof; docs/DESIGN.md §8.3)."""
+    return _digest(chain_root(format_tag) if parent is None else parent, block)
 
 
 def snapshot_records(cache: "PrefixCache") -> List[dict]:
@@ -103,8 +122,8 @@ def snapshot_records(cache: "PrefixCache") -> List[dict]:
     ]
 
 
-def verify_snapshot_records(records: List[dict],
-                            page_size: int) -> Tuple[bool, str]:
+def verify_snapshot_records(records: List[dict], page_size: int,
+                            format_tag: bytes = b"") -> Tuple[bool, str]:
     """Mandatory verify-on-load for a persisted index: every record's
     digest must RECOMPUTE from its parent digest + stored tokens (a
     flipped token or forged digest fails here), parents must precede
@@ -149,7 +168,7 @@ def verify_snapshot_records(records: List[dict],
                     f"record {i}: start {start} not contiguous with "
                     f"parent coverage {expect}"
                 )
-        if chain_digest(parent_bytes, tokens) != digest:
+        if chain_digest(parent_bytes, tokens, format_tag) != digest:
             return False, (
                 f"record {i}: stored digest does not recompute from its "
                 "tokens (corrupt block or forged address)"
@@ -211,9 +230,12 @@ class PrefixCache:
     """See module docstring. Single-threaded like the engine that owns
     it (the engine's scheduling loop is the only caller)."""
 
-    def __init__(self, arena_page_ids: Sequence[int], page_size: int):
+    def __init__(self, arena_page_ids: Sequence[int], page_size: int,
+                 format_tag: bytes = b""):
         assert page_size > 0, page_size
         self.page_size = page_size
+        self.format_tag = format_tag
+        self._root = chain_root(format_tag)
         self.arena_total = len(arena_page_ids)
         self._free_pages: List[int] = list(arena_page_ids)
         self._nodes: Dict[bytes, PageNode] = {}
@@ -278,7 +300,7 @@ class PrefixCache:
         (in ``_note_prefix_outcome``), so its stats stay in lockstep
         with the ``serve.prefix.*`` counters."""
         out: List[PageNode] = []
-        parent = _ROOT
+        parent = self._root
         for block in chain_blocks(tokens, self.page_size):
             node = self._lookup_child(parent, block)
             if node is None:
@@ -298,7 +320,7 @@ class PrefixCache:
         or fault injection — the publish path's dedup check (a publisher
         consulting the chain is not a cache consumer)."""
         out: List[PageNode] = []
-        parent = _ROOT
+        parent = self._root
         for block in chain_blocks(tokens, self.page_size):
             node = self._nodes.get(_digest(parent, block))
             if node is None or not np.array_equal(
@@ -346,7 +368,7 @@ class PrefixCache:
     ) -> PageNode:
         """Commit one published page (dedup is the CALLER's probe-first
         protocol: inserting an existing chain position is a bug)."""
-        parent_digest = _ROOT if parent is None else parent.digest
+        parent_digest = self._root if parent is None else parent.digest
         digest = _digest(parent_digest, block)
         assert digest not in self._nodes, "dedup-on-insert violated"
         node = PageNode(

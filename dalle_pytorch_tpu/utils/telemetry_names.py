@@ -183,6 +183,13 @@ GAUGES = frozenset({
     "serve.prefix_hit_frac",     # hits / (hits + misses), lifetime
     "serve.prefix_pages",        # pages currently held by the index
     "serve.spec_accept_frac",    # accepted / drafted, lifetime
+    # KV storage-format footprint (quantized-KV capacity lever, §6.1):
+    # bytes of K/V storage (content + scale pools) per slot row, and
+    # total physical pages per pool (slots + prefix arena) — int8 pools
+    # roughly halve bytes_per_slot, which is the ~2x pages-at-fixed-HBM
+    # headline bench.py --serve asserts
+    "serve.kv_quant.bytes_per_slot",
+    "serve.kv_quant.pages",
     "router.queued",
     "router.fleet_occupancy",
     "router.replicas_live",

@@ -419,8 +419,14 @@ class TestDegradationOverload:
         assert set(snap) == {
             "serve.pool_occupancy", "serve.running", "serve.prefilling",
             "serve.queued",
+            # KV storage-format footprint (ISSUE 14): published from
+            # construction on, whatever the kv_quant setting
+            "serve.kv_quant.bytes_per_slot", "serve.kv_quant.pages",
         }
         assert snap["serve.running"] == 1
+        assert snap["serve.kv_quant.bytes_per_slot"] == float(
+            eng.kv_bytes_per_slot
+        )
         eng.run(max_steps=200)
         assert gauges.get("serve.pool_occupancy") == 0.0
 

@@ -512,9 +512,24 @@ class TestSpecDispatchContract:
             "publish", "publish_spec"
         ]
         assert copy["donate"] == ["cache"]
+        assert copy["max_host_visible_outputs"] == 0
         across = contract["entries"]["serving.page_copy_across"]
         assert across["max_signatures"] == 1
         assert across["donate"] == ["dst_cache"]
+        assert across["max_host_visible_outputs"] == 0
+        # the quantized prefix engine's int8 + scale-pool trees (ISSUE
+        # 14) are their OWN entries — signature 0 of an entry is what
+        # the audit genuinely lowers and alias-audits, so the quant
+        # trees' extra scale leaves must prove their donation aliasing
+        # here instead of silently loosening the shared 0 budget above
+        for name, label in (
+            ("serving.page_copy_quant", "publish_quant"),
+            ("serving.page_copy_across_quant", "restore_quant"),
+        ):
+            q = contract["entries"][name]
+            assert q["max_signatures"] == 1
+            assert [s["label"] for s in q["signatures"]] == [label]
+            assert q["max_host_visible_outputs"] == 0
 
 
 # ------------------------------------------------ degraded-drafter drill

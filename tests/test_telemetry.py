@@ -573,6 +573,7 @@ def test_telemetry_smoke_gate(tmp_path):
         [l for l in out.stdout.splitlines() if l.startswith('{"flight_file')][0]
     )
     # 3 chunked + 3 monolithic + 3 fused + 3 speculative + 6
+    # quantized-KV (3 split + 3 fused int8 pages; ISSUE 14) + 6
     # prefix-cache cold/warm completions, 1 mid-prefill deadline drill,
     # + 6 from the recovery drill (2 fault-free reference, 2 cold
     # pre-crash, 2 replayed post-restart — the crashed incarnation's 2
@@ -580,7 +581,7 @@ def test_telemetry_smoke_gate(tmp_path):
     # full-hit requests (no prefill span at all) must still close their
     # serve.request chains typed
     assert summary["request_outcomes"] == {
-        "completed": 24, "deadline_exceeded": 1,
+        "completed": 30, "deadline_exceeded": 1,
     }
     assert summary["prefill_chunk_spans"] >= 2
     assert summary["spec_verify_spans"] >= 1

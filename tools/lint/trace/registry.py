@@ -87,16 +87,25 @@ def build_entry_points() -> List[EntryPoint]:
     )["params"]
     internal = jax.eval_shape(dalle.remap_text, text1)  # (1, T) with bos
 
-    def cache_avals(b):
+    def cache_avals(b, kv_quant=None):
         def build(p):
             return set_decode_offsets(
-                init_decode_cache(dalle, p, b, cache_format="paged"),
+                init_decode_cache(
+                    dalle, p, b, cache_format="paged", kv_quant=kv_quant
+                ),
                 jnp.zeros((b,), jnp.int32),
             )
         return jax.eval_shape(build, params)
 
     cache1 = cache_avals(1)
     cacheB = cache_avals(B)
+    # the quantized-KV engine (ops/kv_policy.py kv_quant="int8"): int8
+    # content pools + parallel f32 scale pools — the cache aval change
+    # behind EngineConfig.kv_quant, derived through the engine's own
+    # init path so the committed contract (and its DTL141 byte budget,
+    # the standing guard that quantized KV stays roughly half-size)
+    # tracks the code
+    cacheB_q = cache_avals(B, kv_quant="int8")
     key = jax.eval_shape(lambda: jax.random.key(0))
     keysB = jax.eval_shape(lambda: jnp.stack([jax.random.key(0)] * B))
     # the engine's own top-k formula (Engine.__init__: full-vocab-derived
@@ -121,6 +130,12 @@ def build_entry_points() -> List[EntryPoint]:
     cacheB_arena = jax.eval_shape(
         lambda c: _append_arena_rows(c, arena_rows), cacheB
     )
+    # quantized prefix engine: arena rows appended to the int8 + scale
+    # pools — the publish/COW/restore copy jits run over this tree
+    cacheB_q_arena = jax.eval_shape(
+        lambda c: _append_arena_rows(c, arena_rows), cacheB_q
+    )
+    cache1_q = cache_avals(1, kv_quant="int8")
     # the cached terminal logits (the full-hit payload): the prefill
     # jits' third output, derived abstractly from the same trace
     logits1 = jax.eval_shape(
@@ -323,6 +338,54 @@ def build_entry_points() -> List[EntryPoint]:
             )],
         ),
         EntryPoint(
+            name="serving.decode_quant",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_decode_jit",
+            fn=eng._decode_jit,
+            lower=eng._decode_jit.lower,
+            static_argnums=(0, 6),
+            donate={"cache": 2},
+            # the quantized-KV engine's decode: the SAME program logic
+            # over int8 pools + scale pools — still EXACTLY one steady
+            # signature, at roughly half the cache bytes (the DTL141
+            # budget difference vs serving.decode IS the capacity claim)
+            signatures=[Signature(
+                "steady_quant",
+                (dalle, params, cacheB_q, SDS((B,), jnp.int32),
+                 SDS((B,), jnp.int32), keysB, k_img, 1.0),
+            )],
+        ),
+        EntryPoint(
+            name="serving.iteration_quant",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_iteration_jit",
+            fn=eng._iteration_jit,
+            lower=eng._iteration_jit.lower,
+            static_argnums=(0, 9, 10, 12),
+            donate={"cache": 2},
+            # the quantized fused iteration: quantize-at-append +
+            # in-kernel dequant are in-trace data ops, so the signature
+            # budget stays the same steady/final pair as
+            # serving.iteration — a third signature is the same
+            # shape-drift-recompile bug class
+            signatures=[
+                Signature(
+                    "steady_quant",
+                    (dalle, params, cacheB_q, SDS((B, T), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.bool_), keysB,
+                     cfg.prefill_chunk, k_img, 1.0, False),
+                ),
+                Signature(
+                    "final_quant",
+                    (dalle, params, cacheB_q, SDS((B, T), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.int32),
+                     SDS((B,), jnp.int32), SDS((B,), jnp.bool_), keysB,
+                     cfg.prefill_chunk, k_img, 1.0, True),
+                ),
+            ],
+        ),
+        EntryPoint(
             name="serving.sample_cached",
             path="dalle_pytorch_tpu/serving/engine.py",
             symbol="_sample_cached_jit",
@@ -435,6 +498,29 @@ def build_entry_points() -> List[EntryPoint]:
             ],
         ),
         EntryPoint(
+            name="serving.page_copy_quant",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_copy_pages_jit",
+            fn=eng._copy_pages_jit,
+            lower=eng._copy_pages_jit.lower,
+            static_argnums=(),
+            donate={"cache": 0},
+            # the quantized prefix engine's publish/COW copies (int8 +
+            # scale pools). Its OWN entry, not a third serving.page_copy
+            # signature: the audit lowers and alias-audits signature 0
+            # only and reuses that count for later signatures, so a
+            # tree with 4 extra scale leaves under the shared entry
+            # would read as 4 host-visible outputs (loosening the
+            # budget to 4 for the unquantized path too). As signature 0
+            # here it is genuinely lowered: every leaf must alias into
+            # the donated cache, keeping BOTH entries at the 0
+            # host-visible budget.
+            signatures=[Signature(
+                "publish_quant",
+                (cacheB_q_arena, copy_vec, copy_vec, copy_vec),
+            )],
+        ),
+        EntryPoint(
             name="serving.page_copy_across",
             path="dalle_pytorch_tpu/serving/engine.py",
             symbol="_copy_pages_across_jit",
@@ -448,6 +534,22 @@ def build_entry_points() -> List[EntryPoint]:
             signatures=[Signature(
                 "restore",
                 (cache1, cacheB_arena, copy_vec, copy_vec, copy_vec),
+            )],
+        ),
+        EntryPoint(
+            name="serving.page_copy_across_quant",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_copy_pages_across_jit",
+            fn=eng._copy_pages_across_jit,
+            lower=eng._copy_pages_across_jit.lower,
+            static_argnums=(),
+            donate={"dst_cache": 0},
+            # quantized split-engine partial-hit restore — own entry for
+            # the same signature-0 aliasing-audit reason as
+            # serving.page_copy_quant
+            signatures=[Signature(
+                "restore_quant",
+                (cache1_q, cacheB_q_arena, copy_vec, copy_vec, copy_vec),
             )],
         ),
         _train_entry(dalle, B),

@@ -2,12 +2,16 @@
 """Serving-engine release gate: continuous-batching passes on CPU.
 
 Builds a tiny DALLE in-process (no checkpoint needed) and drives the full
-engine lifecycle five times — CHUNKED prefill (budget-bounded prompt
+engine lifecycle seven times — CHUNKED prefill (budget-bounded prompt
 chunks interleaved with decode; the production serving shape),
 monolithic, FUSED (the whole iteration as one ragged ``_iteration_jit``
 dispatch; ROADMAP 1), SPECULATIVE (ROADMAP 2: each decode row
 self-drafts and the single ragged dispatch verifies — exact acceptance
-makes the stream bit-identical to plain decode by construction), and a
+makes the stream bit-identical to plain decode by construction),
+QUANTIZED-KV split and fused (ISSUE 14: int8 paged pools + per-(token,
+head) scale pools, dequantized at read — the two quantized passes must
+match each other BITWISE, and match the unquantized passes to the
+pinned token-agreement floor, never bitwise), and a
 PREFIX-CACHE cold/warm replay (ROADMAP 3: the same 3-request scenario
 twice through one engine with the content-addressed page index on; the
 warm round must hit and match the cold round bitwise) — verifying the
@@ -410,6 +414,16 @@ def _run_passes(n_replicas: int, preempt) -> int:
     #     DALLE_TPU_FAULTS="spec_verify_abort=1" python tools/serve_smoke.py
     spec = run_pass("spec", prefill_chunk=2, fused_iteration=True,
                     spec_decode=True, spec_k=2)
+    # quantized-KV passes (ISSUE 14): int8 paged pools with per-(token,
+    # head) scale pools, dequantized at read time. Parity tiers: the two
+    # QUANTIZED passes (split-chunked vs fused) must be BIT-identical to
+    # each other — the standing quant-vs-quant contract — while
+    # quant-vs-unquantized is held to the PINNED token-agreement floor
+    # (ops/kv_policy.py:KV_QUANT_TOKEN_AGREEMENT_MIN), never a bitwise
+    # claim. Composes with DALLE_TPU_FAULTS like every pass above.
+    quant = run_pass("kv_quant_chunked", prefill_chunk=2, kv_quant="int8")
+    quant_fused = run_pass("kv_quant_fused", prefill_chunk=2,
+                           fused_iteration=True, kv_quant="int8")
 
     # prefix-cache cold/warm replay (ROADMAP 3): ONE engine with the
     # content-addressed page index runs the SAME 3-request scenario
@@ -500,6 +514,38 @@ def _run_passes(n_replicas: int, preempt) -> int:
                   "from plain decode — the exact-acceptance contract is "
                   "broken", file=sys.stderr)
 
+    # quantized-KV gate: quant-vs-quant bitwise, quant-vs-f32 thresholded
+    from dalle_pytorch_tpu.ops.kv_policy import KV_QUANT_TOKEN_AGREEMENT_MIN
+
+    agree_num = agree_den = 0
+    for rid in sorted(quant):
+        ok = ok and quant[rid].outcome is Outcome.COMPLETED
+        ok = ok and quant_fused[rid].outcome is Outcome.COMPLETED
+        if not np.array_equal(
+            np.asarray(quant[rid].tokens), np.asarray(quant_fused[rid].tokens)
+        ):
+            ok = False
+            print(f"serve smoke FAILED: {rid} quantized fused tokens "
+                  "diverge from the quantized split path — the "
+                  "quant-vs-quant bitwise contract is broken",
+                  file=sys.stderr)
+        both = min(len(quant[rid].tokens), len(chunked[rid].tokens))
+        agree_num += int(np.sum(
+            np.asarray(quant[rid].tokens)[:both]
+            == np.asarray(chunked[rid].tokens)[:both]
+        ))
+        agree_den += both
+    agreement = agree_num / max(agree_den, 1)
+    if agreement < KV_QUANT_TOKEN_AGREEMENT_MIN:
+        ok = False
+        print(f"serve smoke FAILED: kv-int8 token agreement {agreement:.3f} "
+              f"below the pinned {KV_QUANT_TOKEN_AGREEMENT_MIN} floor",
+              file=sys.stderr)
+    print(json.dumps({
+        "pass": "kv_quant", "token_agreement_vs_unquant": agreement,
+        "floor": KV_QUANT_TOKEN_AGREEMENT_MIN,
+    }))
+
     # mid-prefill deadline drill: token_budget=1 throttles prefill to one
     # chunk per iteration (the forward-progress floor), the FakeClock makes
     # "expires mid-prefill" an exact step count, and the pages must be back
@@ -542,7 +588,9 @@ def _run_passes(n_replicas: int, preempt) -> int:
         print("serve smoke FAILED: not every request completed", file=sys.stderr)
         return 1
     print("serve smoke OK: 3/3 completed chunked, monolithic, fused, "
-          "SPECULATIVE (exact-acceptance bit-parity) AND the prefix-cache "
+          "SPECULATIVE (exact-acceptance bit-parity), QUANTIZED-KV "
+          "(split-vs-fused bitwise, agreement >= pinned floor vs f32) "
+          "AND the prefix-cache "
           "cold/warm replay (bit-identical, warm round "
           "hit the index), mid-prefill deadline drill typed, pool drained, "
           "kill-restore-replay recovery drill bit-identical with a warm "

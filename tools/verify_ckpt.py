@@ -83,8 +83,14 @@ def verify_serving(state_dir: str) -> int:
             try:
                 index = _json.loads((snapdir / "index.json").read_text())
                 nodes = index["nodes"]
+                # the chain root is salted by the engine's KV-format tag
+                # (quantized arenas); the snapshot stores the tag its
+                # digests were derived under, so the offline recompute
+                # uses the same root — an engine restore additionally
+                # requires the tag to MATCH its own format
                 ok, reason = verify_snapshot_records(
-                    nodes, int(index["page_size"])
+                    nodes, int(index["page_size"]),
+                    format_tag=index.get("kv_format", "").encode(),
                 )
             except (OSError, ValueError, KeyError, TypeError) as e:
                 ok, reason = False, f"unreadable index: {e!r}"
