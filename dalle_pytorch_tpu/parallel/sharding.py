@@ -95,6 +95,70 @@ def _fsdp_fallback(shape: Sequence[int], mesh: Mesh, min_size: int) -> P:
     return P()
 
 
+def _spec_shards(spec: P, mesh: Mesh) -> bool:
+    """True when the spec actually splits data on this mesh (some axis with
+    extent > 1) — a P("fsdp") on an fsdp=1 mesh shards nothing."""
+    for names in spec:
+        if names is None:
+            continue
+        names = (names,) if isinstance(names, str) else names
+        if int(np.prod([mesh.shape.get(a, 1) for a in names])) > 1:
+            return True
+    return False
+
+
+def spec_report(
+    path: str,
+    shape: Sequence[int],
+    mesh: Mesh,
+    rules: Tuple[Tuple[str, P], ...] = DEFAULT_RULES,
+    min_size: int = 2**14,
+) -> dict:
+    """How the rule engine resolved one parameter — the audit seam the
+    sharding lint stage (tools/lint/shard/, DTL15x) reads.
+
+    Returns ``{"path", "rule", "requested", "spec", "intent_sharded",
+    "sharded"}``: ``rule`` is the matched pattern (None = fallback),
+    ``requested`` the rule's spec BEFORE divisibility degradation,
+    ``spec`` the final answer ``partition_spec`` returns,
+    ``intent_sharded`` whether the rule meant to split data on this mesh
+    and ``sharded`` whether the final spec still does. ``intent_sharded
+    and not sharded`` is exactly the DTL153 accidental-replication case:
+    the declared memory story is fiction for this parameter."""
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            spec = P(*(list(spec) + [None] * (len(shape) - len(spec)))[: len(shape)])
+            requested = spec
+            if not _fits(shape, spec, mesh):
+                # drop non-dividing axes, keep the rest of the rule
+                fixed = []
+                for dim, names in zip(shape, spec):
+                    if names is None:
+                        fixed.append(None)
+                        continue
+                    tup = (names,) if isinstance(names, str) else names
+                    extent = int(np.prod([mesh.shape.get(a, 1) for a in tup]))
+                    fixed.append(names if dim % extent == 0 else None)
+                spec = P(*fixed)
+            return {
+                "path": path,
+                "rule": pattern,
+                "requested": requested,
+                "spec": spec,
+                "intent_sharded": _spec_shards(requested, mesh),
+                "sharded": _spec_shards(spec, mesh),
+            }
+    spec = _fsdp_fallback(shape, mesh, min_size)
+    return {
+        "path": path,
+        "rule": None,
+        "requested": spec,
+        "spec": spec,
+        "intent_sharded": _spec_shards(spec, mesh),
+        "sharded": _spec_shards(spec, mesh),
+    }
+
+
 def partition_spec(
     path: str,
     shape: Sequence[int],
@@ -104,22 +168,7 @@ def partition_spec(
 ) -> P:
     """The PartitionSpec for one parameter. Rules that don't divide the shape
     degrade gracefully: offending axes are dropped from the spec."""
-    for pattern, spec in rules:
-        if re.search(pattern, path):
-            spec = P(*(list(spec) + [None] * (len(shape) - len(spec)))[: len(shape)])
-            if _fits(shape, spec, mesh):
-                return spec
-            # drop non-dividing axes, keep the rest of the rule
-            fixed = []
-            for dim, names in zip(shape, spec):
-                if names is None:
-                    fixed.append(None)
-                    continue
-                tup = (names,) if isinstance(names, str) else names
-                extent = int(np.prod([mesh.shape.get(a, 1) for a in tup]))
-                fixed.append(names if dim % extent == 0 else None)
-            return P(*fixed)
-    return _fsdp_fallback(shape, mesh, min_size)
+    return spec_report(path, shape, mesh, rules, min_size)["spec"]
 
 
 def params_shardings(
@@ -135,6 +184,27 @@ def params_shardings(
         return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def params_spec_reports(
+    params: Any,
+    mesh: Mesh,
+    rules: Tuple[Tuple[str, P], ...] = DEFAULT_RULES,
+    min_size: int = 2**14,
+) -> list:
+    """One :func:`spec_report` per parameter leaf, in tree-flatten order —
+    the same order the leaves appear as flattened jit arguments, which is
+    how the sharding audit joins intent (this list) with the lowered
+    program's actual per-argument shardings."""
+    out = []
+
+    def report(path, leaf):
+        out.append(spec_report(_path_str(path), leaf.shape, mesh, rules,
+                               min_size))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(report, params)
+    return out
 
 
 def opt_state_shardings(opt_state: Any, params_shardings_tree: Any, mesh: Mesh) -> Any:

@@ -1,0 +1,106 @@
+"""Known-bad lock-ORDER fixtures (DTL052; tests/test_static_analysis.py).
+
+AST-parsed only, never imported. Seeds: two order-inversion cycles (one
+in a table-less lock-owning class, one in a ``_GUARDED_BY`` class whose
+first edge sits in a ``*_locked`` method — ordering is checked
+everywhere, the DTL051 exemption does not apply), one non-reentrant
+self-deadlock, a sanctioned RLock reentry (clean), one inline-suppressed
+cycle, and the baseline-grandfathering escape (the test supplies the
+baseline file).
+"""
+
+import threading
+
+
+class CycleAB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:          # line 23: DTL052 a->b vs b->a below
+                self.x += 1
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                self.x -= 1
+
+
+class SelfDeadlock:
+    def __init__(self):
+        self._m = threading.Lock()
+
+    def outer(self):
+        with self._m:
+            with self._m:          # line 38: DTL052 plain-Lock re-acquire
+                pass
+
+
+class ReentrantOK:
+    def __init__(self):
+        self._r = threading.RLock()
+
+    def outer(self):
+        with self._r:
+            with self._r:          # RLock reentry: sanctioned, clean
+                pass
+
+
+class CycleSuppressed:
+    def __init__(self):
+        self._c = threading.Lock()
+        self._d = threading.Lock()
+
+    def one(self):
+        with self._c:
+            with self._d:  # dtl: disable=DTL052
+                pass
+
+    def two(self):
+        with self._d:
+            with self._c:
+                pass
+
+
+class CycleBaselined:
+    _GUARDED_BY = {"_e": ("val",)}
+
+    def __init__(self):
+        self._e = threading.Lock()
+        self._f = threading.Lock()
+        self.val = 0
+
+    def one_locked(self):
+        with self._e:
+            with self._f:          # line 78: DTL052 (baselined in test)
+                pass
+
+    def two(self):
+        with self._f:
+            with self._e:
+                pass
+
+
+class ClosureNotAnEdge:
+    """A nested def merely DEFINED under a lock runs later, without it:
+    its acquisitions are NOT ordering edges, so the g/h orders here are
+    deadlock-free and must stay clean."""
+
+    def __init__(self):
+        self._g = threading.Lock()
+        self._h = threading.Lock()
+
+    def spawn(self):
+        with self._g:
+            def worker():
+                with self._h:      # runs on another thread, _g not held
+                    pass
+            return worker
+
+    def other(self):
+        with self._h:
+            with self._g:
+                pass

@@ -573,3 +573,281 @@ class TestTraceCLI:
             (REPO / "tools" / "trace_contracts.json").read_text()
         )
         assert emitted == committed
+
+
+# --------------------------------------------------- lock-order cycles
+
+
+class TestLockOrder:
+    """DTL052 fixture corpus (tests/fixtures_lint/fx_lock_order.py):
+    order-inversion cycles, the non-reentrant self-deadlock, the RLock
+    reentry exemption, and the two escape hatches."""
+
+    def run(self, baseline=None):
+        cfg = fixture_config(baseline_path=baseline)
+        return run_lint(cfg, paths=[f"{FX}/fx_lock_order.py"],
+                        checkers=["locks"])
+
+    def test_exact_codes_and_lines(self):
+        res = self.run()
+        assert codes_lines(res.findings) == [
+            ("DTL052", 23),   # CycleAB: a->b vs b->a inversion
+            ("DTL052", 38),   # SelfDeadlock: plain-Lock re-acquire
+            ("DTL052", 78),   # CycleBaselined (no baseline in this run)
+        ], [f.render() for f in res.findings]
+
+    def test_anchors_name_the_cycle(self):
+        res = self.run()
+        assert sorted(f.anchor for f in res.findings) == [
+            "CycleAB:_a->_b",
+            "CycleBaselined:_e->_f",
+            "SelfDeadlock:_m->_m",
+        ]
+
+    def test_rlock_reentry_is_sanctioned(self):
+        # ReentrantOK nests an RLock under itself — the Router pattern —
+        # and must stay clean
+        res = self.run()
+        assert not any("ReentrantOK" in f.anchor for f in res.findings)
+
+    def test_closure_acquisition_is_not_an_edge(self):
+        # a nested def DEFINED under a lock executes later without it:
+        # ClosureNotAnEdge's worker must not create a phantom g->h edge
+        # (its h->g order elsewhere is the only real one — no cycle)
+        res = self.run()
+        assert not any("ClosureNotAnEdge" in f.anchor
+                       for f in res.findings)
+
+    def test_inline_suppression(self):
+        res = self.run()
+        assert [(f.code, f.line) for f in res.suppressed] == [
+            ("DTL052", 59),
+        ]
+        assert not any("CycleSuppressed" in f.anchor for f in res.findings)
+
+    def test_baseline_grandfathers(self, tmp_path):
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps([{
+            "key": f"{FX}/fx_lock_order.py::DTL052::CycleBaselined:_e->_f",
+            "note": "fixture: grandfathered lock-order cycle",
+        }]))
+        res = self.run(baseline=str(bl))
+        assert [(f.code, f.anchor) for f in res.baselined] == [
+            ("DTL052", "CycleBaselined:_e->_f"),
+        ]
+        assert not any("CycleBaselined" in f.anchor for f in res.findings)
+
+    def test_repo_lock_classes_are_cycle_free(self):
+        """The production lock owners (Router, metrics, telemetry) must
+        stay acyclic — finding nothing IS the assertion."""
+        res = run_lint(default_config(str(REPO)), checkers=["locks"])
+        assert res.clean, [f.render() for f in res.findings]
+
+
+# ------------------------------------------------------- shard stage
+
+
+_SHARD_CACHE: dict = {}
+
+
+def shard_fixture_raw():
+    """Audit the fixture shard registry once per session (lowers every
+    fixture jit over the 2-device host mesh and compiles the one
+    partitioned entry — cached so each pinned-code test below reads the
+    same result instead of re-lowering)."""
+    if "raw" not in _SHARD_CACHE:
+        from lint.shard import run_shard  # imports jax (fixture jits)
+
+        _SHARD_CACHE["raw"] = run_shard(
+            str(REPO),
+            f"{FX}/fx_shard_registry.py",
+            f"{FX}/fx_shard_contract.json",
+        )
+    return _SHARD_CACHE["raw"]
+
+
+def shard_fixture_result(baseline=None):
+    findings, reports = shard_fixture_raw()
+    cfg = fixture_config(baseline_path=baseline)
+    res = run_lint(cfg, paths=[f"{FX}/fx_shard_registry.py"], checkers=[],
+                   full=True, extra_findings=findings, stages={"shard"})
+    return res, reports
+
+
+class TestShard:
+    """Fixture corpus for the --shard stage (tools/lint/shard/): >=2
+    seeded violations per DTL15x checker family at pinned codes and
+    anchors, plus the suppression/baseline escapes and the
+    contract-file round trip."""
+
+    def test_exact_codes_and_anchors(self):
+        res, _ = shard_fixture_result()
+        got = sorted((f.code, f.anchor) for f in res.findings)
+        assert got == [
+            ("DTL151", "fx.noisy:all-reduce"),        # over budget
+            ("DTL151", "fx.unlisted:collective-permute"),  # unlisted kind
+            ("DTL152", "fx.drifted:lowered"),         # rules vs lowered
+            ("DTL152", "fx.stale_contract:contract"),  # contract drift
+            ("DTL153", "fx.replicated:w1"),           # declared sharded,
+            ("DTL153", "fx.replicated:w2"),           # lowered replicated
+            ("DTL154", "fx.resharder"),               # 2 constraints > 0
+            ("DTL154", "fx.resharder2"),              # 3 constraints > 1
+            ("DTL155", "fx.ghost"),                   # contract-only: stale
+            ("DTL155", "fx.uncommitted"),             # registered, uncommitted
+        ], [f.render() for f in res.findings]
+
+    def test_findings_anchor_on_def_lines(self):
+        res, _ = shard_fixture_result()
+        f = next(x for x in res.findings if x.anchor == "fx.resharder")
+        assert f.line == 87 and f.path == f"{FX}/fx_shard_registry.py"
+        ghost = next(x for x in res.findings if x.anchor == "fx.ghost")
+        assert ghost.path == f"{FX}/fx_shard_contract.json"
+
+    def test_inline_suppression(self):
+        # fx.sneaky is over its all-reduce budget exactly like fx.noisy
+        # but carries `# dtl: disable=DTL151` on its def line
+        res, _ = shard_fixture_result()
+        assert [(f.code, f.anchor) for f in res.suppressed] == [
+            ("DTL151", "fx.sneaky:all-reduce"),
+        ]
+
+    def test_clean_entries_stay_clean(self):
+        # fx.clean (lowered) and fx.partitioned (compiled, with its one
+        # contracted GSPMD all-reduce) match the contract exactly
+        res, reports = shard_fixture_result()
+        for name in ("fx.clean", "fx.partitioned"):
+            assert not any(name in f.anchor for f in res.findings)
+        part = next(r for r in reports if r["name"] == "fx.partitioned")
+        assert part["level"] == "partitioned"
+        assert part["collectives"] == {"all-reduce": 1}
+
+    def test_baseline_grandfathers_with_stable_key(self, tmp_path):
+        bl = tmp_path / "shard_baseline.json"
+        bl.write_text(json.dumps([{
+            "key": f"{FX}/fx_shard_registry.py::DTL154::fx.resharder2",
+            "note": "fixture: grandfathered reshard-budget overrun",
+        }]))
+        res, _ = shard_fixture_result(baseline=str(bl))
+        assert ("DTL154", "fx.resharder2") not in [
+            (f.code, f.anchor) for f in res.findings
+        ]
+        assert [(f.code, f.anchor) for f in res.baselined] == [
+            ("DTL154", "fx.resharder2"),
+        ]
+        assert res.stale_baseline == []
+
+    def test_emit_contract_round_trip(self):
+        """A contract regenerated from the current registry must clear
+        every budget/1:1 finding — what survives is exactly the
+        code-level drift: DTL152's rules-vs-lowered disagreement and
+        DTL153's accidental replication live in the code, not the
+        contract, so re-emitting cannot paper over them."""
+        from lint.shard import check_reports, emit_contract
+
+        _, reports = shard_fixture_raw()
+        fresh = emit_contract(reports)
+        findings = check_reports(reports, fresh, "fresh.json", str(REPO))
+        got = sorted((f.code, f.anchor) for f in findings)
+        assert got == [
+            ("DTL152", "fx.drifted:lowered"),
+            ("DTL153", "fx.replicated:w1"),
+            ("DTL153", "fx.replicated:w2"),
+        ], got
+
+    def test_shard_baseline_key_unseen_unless_shard_ran(self, tmp_path):
+        """A baselined DTL15x key must NOT be judged stale by a scan
+        that never ran the shard stage — a trace-only `--trace --check`
+        run (stages={'trace'}) treats it as unseen, a shard run
+        (stages={'shard'}) judges it."""
+        bl = tmp_path / "bl.json"
+        key = f"{FX}/fx_shard_registry.py::DTL151::fx.gone"
+        bl.write_text(json.dumps([{"key": key, "note": "fixed long ago"}]))
+        cfg = fixture_config(baseline_path=str(bl))
+        res = run_lint(cfg, paths=[f"{FX}/fx_purity.py"], checkers=[],
+                       full=True, extra_findings=[], stages={"trace"})
+        assert res.stale_baseline == []
+        res = run_lint(cfg, paths=[f"{FX}/fx_purity.py"], checkers=[],
+                       full=True, extra_findings=[], stages={"shard"})
+        assert res.stale_baseline == [key]
+
+    def test_serving_entries_commit_zero_collectives(self):
+        """The committed repo contract IS the 'no collectives in
+        serving' baseline ROADMAP item 1 will renegotiate: every
+        serving.* entry must budget an empty collective map, and the
+        six train.* mesh-kind entries must all be present."""
+        committed = json.loads(
+            (REPO / "tools" / "shard_contracts.json").read_text()
+        )
+        entries = committed["entries"]
+        kinds = {n.split(".", 1)[1] for n in entries if n.startswith("train.")}
+        assert kinds == {"dp", "fsdp", "tp", "sp", "pp", "ep"}
+        serving = [n for n in entries if n.startswith("serving.")]
+        assert len(serving) >= 10
+        for name in serving:
+            assert entries[name]["collectives"] == {}, name
+        # the sharded mesh kinds actually shard: fsdp/tp commit sharded
+        # param specs and nonzero collective budgets
+        for kind in ("fsdp", "tp"):
+            e = entries[f"train.{kind}"]
+            assert e["param_specs"], kind
+            assert e["collectives"], kind
+
+
+class TestShardCLI:
+    """--shard through the real CLI: composition in one exit code, and
+    THE acceptance gate on the repo contract."""
+
+    def test_fixture_registry_fails_check(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"),
+             "--shard", "--check",
+             "--shard-registry", f"{FX}/fx_shard_registry.py",
+             "--shard-contract", f"{FX}/fx_shard_contract.json",
+             f"{FX}/fx_shard_registry.py"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 1, proc.stderr
+        for code in ("DTL151", "DTL152", "DTL153", "DTL154", "DTL155"):
+            assert code in proc.stdout, (code, proc.stdout)
+        # the suppressed fx.sneaky overrun must NOT be a live finding
+        assert "fx.sneaky" not in proc.stdout
+
+    def test_repo_shard_gate_exits_zero(self):
+        """THE acceptance gate: make_train_step under all six mesh kinds
+        and every registered serving jit match
+        tools/shard_contracts.json — collective budgets closed, specs
+        agreed, nothing accidentally replicated, reshard sites
+        budgeted."""
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"),
+             "--shard", "--check"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, (
+            f"lint --shard --check failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+
+    def test_emit_contract_matches_committed(self):
+        """The committed shard contract is exactly what --emit-contract
+        derives from the current registry — the pinned round trip."""
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "lint.py"),
+             "--shard", "--emit-contract"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr
+        emitted = json.loads(proc.stdout)
+        committed = json.loads(
+            (REPO / "tools" / "shard_contracts.json").read_text()
+        )
+        assert emitted == committed
+
+    def test_emit_contract_requires_exactly_one_stage(self):
+        for args in (["--emit-contract"],
+                     ["--trace", "--shard", "--emit-contract"]):
+            proc = subprocess.run(
+                [sys.executable, str(REPO / "tools" / "lint.py"), *args],
+                capture_output=True, text=True, cwd=REPO,
+            )
+            assert proc.returncode == 2, (args, proc.stdout)
+            assert "exactly one of" in proc.stderr

@@ -31,6 +31,10 @@ The gate, checked every iteration and at the end:
 * at least one request completes (a soak that rejects everything is a
   failed soak, not a passed one).
 
+Like the other gate tools, the soak runs the full three-stage lint
+pre-flight (AST + trace + shard contracts, docs/DESIGN.md §11) before
+arming anything — a chaos pass over a broken build proves nothing.
+
 Quick deterministic mode (the default: ``--iters 120 --seed 0``) is the
 fast-tier subprocess gate (tests/test_recovery.py); longer soaks ride
 ``--iters``/``--seed`` sweeps behind the slow tier::
@@ -292,6 +296,16 @@ def main(argv=None) -> int:
     ap.add_argument("--snap-every", type=int, default=15,
                     help="prefix snapshot period (0 = never)")
     args = ap.parse_args(argv)
+
+    # static-analysis pre-flight (docs/DESIGN.md §11), the same three
+    # stages as the other gate tools (tools/serve_smoke.py): a corrupt
+    # tree, a drifted serving-jit contract, or a collective smuggled
+    # into a serving program must fail the soak BEFORE any fault is
+    # armed — a chaos gate over a broken build proves nothing
+    from serve_smoke import lint_preflight
+
+    if lint_preflight(label="chaos soak") != 0:
+        return 1
 
     summary = run_soak(
         iters=args.iters, seed=args.seed, n_replicas=args.replicas,

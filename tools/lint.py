@@ -1,15 +1,16 @@
 #!/usr/bin/env python
-"""dalle-tpu-lint CLI: AST + trace-level invariant checks for this repo.
+"""dalle-tpu-lint CLI: AST + trace + shard-level invariant checks.
 
 Usage::
 
     python tools/lint.py [--json] [--check] [--checks a,b,...]
-                         [--trace] [--emit-contract] [paths...]
+                         [--trace] [--shard] [--emit-contract] [paths...]
 
 * no flags: report findings (human-readable), always exit 0;
 * ``--check``: exit 1 when any non-suppressed, non-baselined finding
-  survives — the release-gate / CI mode (tools/serve_smoke.py and
-  tools/telemetry_smoke.py run this as their pre-flight);
+  survives — the release-gate / CI mode (tools/serve_smoke.py,
+  tools/telemetry_smoke.py and tools/chaos_soak.py run this as their
+  pre-flight);
 * ``--json``: one JSON object per finding on stdout;
 * ``--checks``: comma list from {purity, layering, fault-sites,
   telemetry-names, locks} (default: all);
@@ -20,21 +21,32 @@ Usage::
   ``tools/trace_contracts.json`` (DTL1xx codes). This stage imports jax
   and the package (still CPU-only, no device execution) and composes
   with the AST stage in one exit code;
-* ``--emit-contract`` (with ``--trace``): print the contract JSON
-  derived from the current registry to stdout and exit — the blessed
-  update after an intentional signature/footprint change;
-* ``--trace-registry`` / ``--contract``: override the registry module /
-  contract file (fixture tests use these);
+* ``--shard``: ALSO run the mesh stage (tools/lint/shard/): lower
+  ``make_train_step`` under each of the six mesh kinds over a forced
+  8-device host platform (plus every serving jit under its 1-device
+  placement) and audit collective budgets, in/out sharding specs,
+  accidental replication, and in-program reshard constraints against
+  the committed ``tools/shard_contracts.json`` (DTL15x codes). Host CPU
+  only — no TPU anywhere; composes with the other stages in one exit
+  code;
+* ``--emit-contract`` (with exactly one of ``--trace``/``--shard``):
+  print the contract JSON derived from the current registry to stdout
+  and exit — the blessed update after an intentional signature/
+  footprint/budget change;
+* ``--trace-registry`` / ``--contract`` and ``--shard-registry`` /
+  ``--shard-contract``: override the registry module / contract file
+  per stage (fixture tests use these);
 * ``paths``: repo-relative files/dirs for the AST stage (default: the
-  package + CLI entrypoints — see tools/lint/config.py). The trace
-  stage always audits every registered entry point.
+  package + CLI entrypoints — see tools/lint/config.py). The trace and
+  shard stages always audit every registered entry point.
 
 Finding codes, the suppression comment (``# dtl: disable=DTL0xx``), and
 the baseline policy (tools/lint_baseline.json) are documented in
-docs/DESIGN.md §11, tools/lint/__init__.py (DTL0xx), and
-tools/lint/trace/__init__.py (DTL1xx). Without ``--trace`` the linter
-is stdlib-only and never imports the package it checks — it runs in
-milliseconds with no jax in sight.
+docs/DESIGN.md §11, tools/lint/__init__.py (DTL0xx),
+tools/lint/trace/__init__.py (DTL1xx), and tools/lint/shard/__init__.py
+(DTL15x). Without ``--trace``/``--shard`` the linter is stdlib-only and
+never imports the package it checks — it runs in milliseconds with no
+jax in sight.
 """
 
 from __future__ import annotations
@@ -70,15 +82,25 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", action="store_true",
                     help="also run the trace-level jaxpr/lowering audit "
                          "(DTL1xx; imports jax, CPU-only)")
+    ap.add_argument("--shard", action="store_true",
+                    help="also run the mesh-aware sharding/collective "
+                         "audit (DTL15x; imports jax, forces an 8-device "
+                         "host platform, CPU-only)")
     ap.add_argument("--emit-contract", action="store_true",
                     dest="emit_contract",
-                    help="with --trace: print the contract JSON derived "
-                         "from the current registry and exit")
+                    help="with --trace or --shard: print that stage's "
+                         "contract JSON derived from the current registry "
+                         "and exit")
     ap.add_argument("--contract", default=None,
                     help="override the trace contract file "
                          "(default: tools/trace_contracts.json)")
     ap.add_argument("--trace-registry", default=None, dest="trace_registry",
                     help="override the trace registry module path")
+    ap.add_argument("--shard-contract", default=None, dest="shard_contract",
+                    help="override the shard contract file "
+                         "(default: tools/shard_contracts.json)")
+    ap.add_argument("--shard-registry", default=None, dest="shard_registry",
+                    help="override the shard registry module path")
     ap.add_argument("paths", nargs="*",
                     help="repo-relative files/dirs (default: scan roots)")
     args = ap.parse_args(argv)
@@ -93,14 +115,32 @@ def main(argv=None) -> int:
         if args.checks else None
     )
 
-    trace_findings = None
-    if args.trace:
-        # imported HERE, not at module top: the trace stage pulls in jax
-        # and the audited package; the AST-only invocation stays
-        # stdlib-pure and millisecond-fast. CPU-pinned: the audit is
-        # abstract (eval_shape/make_jaxpr/lower, no execution) and must
-        # not grab an accelerator just to read avals.
+    if args.emit_contract and args.trace == args.shard:
+        print("lint: --emit-contract requires exactly one of --trace / "
+              "--shard (each stage owns its own contract file)",
+              file=sys.stderr)
+        return 2
+
+    extra_findings = None
+    stages = set()
+    if args.trace or args.shard:
+        # env prepared HERE, before any jax import: the semantic stages
+        # pull in jax and the audited package; the AST-only invocation
+        # stays stdlib-pure and millisecond-fast. CPU-pinned: the audits
+        # are abstract/host-only (eval_shape/make_jaxpr/lower + host-CPU
+        # compiles for the mesh stage) and must not grab an accelerator.
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        if args.shard:
+            # the mesh audit needs a multi-device host platform (the
+            # test suite's own 8-virtual-device setup); must be set
+            # before jax initializes its backend
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+        extra_findings = []
+    if args.trace:
         from lint.trace import emit_contract, run_trace, trace_reports_only
 
         tcfg = config.trace
@@ -118,6 +158,8 @@ def main(argv=None) -> int:
                 SyntaxError) as e:
             print(f"lint: trace stage error: {e}", file=sys.stderr)
             return 2
+        extra_findings.extend(trace_findings)
+        stages.add("trace")
         if not args.as_json:
             # the per-jit report (signatures / readbacks / HBM) goes to
             # stderr: it is operator context, not findings
@@ -131,13 +173,49 @@ def main(argv=None) -> int:
                     f"(aliased {r['signatures'][0]['aliased_bytes']})",
                     file=sys.stderr,
                 )
-    elif args.emit_contract:
-        print("lint: --emit-contract requires --trace", file=sys.stderr)
-        return 2
+    if args.shard:
+        from lint.shard import (
+            emit_contract as emit_shard_contract,
+            run_shard,
+            shard_reports_only,
+        )
+
+        scfg = config.shard
+        registry = args.shard_registry or scfg.registry_path
+        contract = args.shard_contract or scfg.contract_path
+        try:
+            if args.emit_contract:
+                reports = shard_reports_only(_REPO_ROOT, registry)
+                print(json.dumps(emit_shard_contract(reports), indent=2))
+                return 0
+            shard_findings, reports = run_shard(
+                _REPO_ROOT, registry, contract
+            )
+        except (ImportError, ValueError, OSError, RuntimeError,
+                SyntaxError, AssertionError) as e:
+            print(f"lint: shard stage error: {e}", file=sys.stderr)
+            return 2
+        extra_findings.extend(shard_findings)
+        stages.add("shard")
+        if not args.as_json:
+            # per-entry mesh report to stderr: operator context
+            for r in sorted(reports, key=lambda r: r["name"]):
+                mesh = ",".join(f"{k}={v}" for k, v in r["mesh"].items())
+                coll = (", ".join(f"{k}:{v}" for k, v in
+                                  sorted(r["collectives"].items()))
+                        or "none")
+                print(
+                    f"lint: shard {r['name']} [{mesh or '1-device'}] "
+                    f"({r['level']}): collectives {coll}; "
+                    f"{r['reshard_constraints']} reshard constraint(s); "
+                    f"{r['sharded_in_args']}/{r['in_args']} sharded args",
+                    file=sys.stderr,
+                )
 
     try:
         result = run_lint(config, paths=args.paths or None, checkers=checkers,
-                          extra_findings=trace_findings)
+                          extra_findings=extra_findings,
+                          stages=stages or None)
     except (ValueError, OSError, SyntaxError) as e:
         print(f"lint: error: {e}", file=sys.stderr)
         return 2

@@ -44,6 +44,13 @@ DTL042     telemetry names: a registry entry is absent from the
 DTL051     lock discipline: a field declared in a class's ``_GUARDED_BY``
            table is read/written outside a ``with self.<lock>`` block
            (``__init__`` and ``*_locked`` callee-convention methods exempt)
+DTL052     lock-order cycle: two locks of one class are lexically acquired
+           in opposite nesting orders somewhere (deadlock under the right
+           interleaving), or a non-reentrant ``threading.Lock`` is
+           re-acquired under itself; the acquisition graph is built from
+           ``_GUARDED_BY`` keys plus ``__init__`` Lock/RLock/Condition
+           assignments, across ALL methods (no ``*_locked`` exemption —
+           ordering matters wherever it happens)
 =========  ==================================================================
 
 Suppression: append ``# dtl: disable=DTL0xx[,DTL0yy]`` to the finding's
@@ -52,15 +59,20 @@ baseline (``tools/lint_baseline.json``) with a justification note —
 ``--check`` ignores baselined findings but reports stale entries.
 
 Stdlib-``ast`` only, no third-party deps, never imports the package it
-lints (so it runs in milliseconds, jax-free, anywhere). The ONE
-exception is the optional second stage under ``tools/lint/trace/``
-(``lint.py --trace``, DTL1xx codes): a semantic audit that traces the
-registered jit entry points to ClosedJaxprs (abstract avals, CPU, no
-execution) and checks compile-signature budgets, buffer donation/
-aliasing, host syncs, and static HBM footprints against the committed
-``tools/trace_contracts.json``. It imports jax and the package, so this
-package's ``__init__`` must never import it — the CLI loads it on
-demand, and findings share the suppression/baseline machinery here.
+lints (so it runs in milliseconds, jax-free, anywhere). The exceptions
+are the optional later stages: ``tools/lint/trace/`` (``lint.py
+--trace``, DTL1xx codes) traces the registered jit entry points to
+ClosedJaxprs (abstract avals, CPU, no execution) and checks
+compile-signature budgets, buffer donation/aliasing, host syncs, and
+static HBM footprints against the committed
+``tools/trace_contracts.json``; ``tools/lint/shard/`` (``lint.py
+--shard``, DTL15x codes) lowers the train step under each of the six
+mesh kinds over a forced multi-device host platform and audits
+collective budgets, sharding specs, accidental replication, and
+reshard constraints against ``tools/shard_contracts.json``. Both
+import jax and the package, so this package's ``__init__`` must never
+import them — the CLI loads them on demand, and their findings share
+the suppression/baseline machinery here.
 """
 
 from __future__ import annotations

@@ -66,6 +66,17 @@ class TraceConfig:
 
 
 @dataclass(frozen=True)
+class ShardConfig:
+    """Shard-stage (``--shard``) inputs: the mesh-aware entry-point
+    registry module (imported by file path, jax + package on demand —
+    the trace-stage pattern) and the committed contract file the
+    collective/sharding audit gates against."""
+
+    registry_path: str = "tools/lint/shard/registry.py"
+    contract_path: str = "tools/shard_contracts.json"
+
+
+@dataclass(frozen=True)
 class LintConfig:
     repo_root: str
     # files/dirs (repo-relative) the checkers scan by default
@@ -77,6 +88,7 @@ class LintConfig:
     names: Optional[NamesConfig]
     baseline_path: Optional[str] = None
     trace: Optional[TraceConfig] = None
+    shard: Optional[ShardConfig] = None
 
 
 # the host-side observability/resilience layer: imported from loader
@@ -163,4 +175,5 @@ def default_config(repo_root: str) -> LintConfig:
         ),
         baseline_path="tools/lint_baseline.json",
         trace=TraceConfig(),
+        shard=ShardConfig(),
     )

@@ -147,17 +147,22 @@ def load_baseline(path: Optional[str]) -> Dict[str, str]:
 def run_lint(config, paths: Optional[Sequence[str]] = None,
              checkers: Optional[Sequence[str]] = None,
              full: Optional[bool] = None,
-             extra_findings: Optional[Sequence[Finding]] = None) -> LintResult:
+             extra_findings: Optional[Sequence[Finding]] = None,
+             stages: Optional[Set[str]] = None) -> LintResult:
     """Run the selected checkers (default: all configured) over ``paths``
     (default: the config's scan roots) and fold in suppressions and the
     baseline. ``full`` controls the registry-completeness directions
     (DTL032/033/042) — default: on exactly when scanning the full
     roots; fixture tests scanning explicit paths against their own
     miniature registries pass ``full=True``. ``extra_findings`` are
-    pre-computed findings from another stage (the ``--trace`` jaxpr
-    audit) merged in BEFORE suppression/baseline processing, so both
-    stages share one suppression syntax, one baseline file, and one
-    exit code."""
+    pre-computed findings from other stages (the ``--trace`` jaxpr audit
+    and/or the ``--shard`` mesh audit) merged in BEFORE suppression/
+    baseline processing, so every stage shares one suppression syntax,
+    one baseline file, and one exit code. ``stages`` names which extra
+    stages actually RAN (subset of {"trace", "shard"}) — baseline
+    staleness for a stage's codes is only judgeable when that stage ran;
+    default: both when ``extra_findings`` is not None (one combined
+    list), neither otherwise."""
     from . import fault_sites, layering, locks, names, purity
 
     registry = {
@@ -246,16 +251,23 @@ def run_lint(config, paths: Optional[Sequence[str]] = None,
             live.append(f)
     # staleness is only judgeable over the full scan roots — on a
     # narrowed path list, entries for unscanned files are merely unseen.
-    # Same logic for STAGES: a DTL1xx (trace-stage) baseline key can only
-    # match when the trace stage ran (extra_findings is not None — an
-    # empty list still means "ran, found nothing"), so an AST-only scan
-    # must treat it as unseen, not stale, or a legitimately baselined
-    # trace finding would fail every plain `--check` run.
+    # Same logic for STAGES: a DTL1xx (trace-stage) or DTL15x
+    # (shard-stage) baseline key can only match when its stage ran (an
+    # empty extra_findings list still means "ran, found nothing"), so an
+    # AST-only scan must treat it as unseen, not stale, or a
+    # legitimately baselined trace/shard finding would fail every plain
+    # `--check` run.
+    if stages is None:
+        stages = ({"trace", "shard"} if extra_findings is not None
+                  else set())
+
     def judgeable(key: str) -> bool:
         parts = key.split("::")
         code = parts[1] if len(parts) > 1 else ""
+        if code.startswith("DTL15"):
+            return "shard" in stages
         if code.startswith("DTL1"):
-            return extra_findings is not None
+            return "trace" in stages
         return True
 
     stale = (

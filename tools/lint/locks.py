@@ -1,4 +1,4 @@
-"""DTL051: lock discipline via per-class ``_GUARDED_BY`` tables.
+"""DTL051/DTL052: lock discipline via per-class ``_GUARDED_BY`` tables.
 
 A class declares which of its fields its lock guards::
 
@@ -24,6 +24,23 @@ Conventions (each one is a reviewed, visible signal at the def site):
   keep those out of guarded classes).
 * Reads and writes are treated identically: torn reads on a field the
   table says is guarded are findings too.
+
+DTL052 — lock-order cycle detection — rides the same scan: every lock a
+class owns (a ``_GUARDED_BY`` key, or a ``self.<attr> =
+threading.Lock()/RLock()/Condition()`` assignment in ``__init__``)
+becomes a graph node, and every LEXICALLY nested acquisition (``with
+self._b:`` inside a ``with self._a:`` region, across all methods —
+``__init__`` and ``*_locked`` included, since ordering matters wherever
+it happens) adds an ``a -> b`` edge. Any cycle — two methods acquiring
+two locks in opposite orders — is a deadlock waiting for the right
+thread interleaving, and a finding. A self-edge (``with self._a``
+nested under itself) is a finding only for a non-reentrant
+``threading.Lock``: re-acquiring an RLock is this codebase's sanctioned
+pattern (Router's fleet_occupancy reentry), re-acquiring a plain Lock
+is a guaranteed single-thread deadlock. Lexical scope means
+call-through cycles (method A holds lock 1 and CALLS something
+acquiring lock 2) are out of scope — keep cross-object calls out of
+locked regions, which DTL051's field table already pushes toward.
 """
 
 from __future__ import annotations
@@ -100,6 +117,156 @@ def _is_self_attr(node: ast.AST, attr: str) -> bool:
     )
 
 
+_LOCK_CTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+}
+
+
+def _lock_kinds(cls: ast.ClassDef,
+                table: Optional[Dict[str, Tuple[str, ...]]]) -> Dict[str, Optional[str]]:
+    """attr -> constructor kind for every lock this class owns: the
+    ``_GUARDED_BY`` keys (kind unknown until the ctor is seen) plus any
+    ``self.<attr> = threading.Lock()/RLock()/Condition()`` in
+    ``__init__`` — so DTL052 covers lock-owning classes that never
+    declared a field table."""
+    from .core import dotted_name
+
+    kinds: Dict[str, Optional[str]] = {
+        lock: None for lock in (table or {})
+    }
+    for node in cls.body:
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "__init__"):
+            continue
+        for stmt in ast.walk(node):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if not isinstance(stmt.value, ast.Call):
+                continue
+            kind = _LOCK_CTORS.get(dotted_name(stmt.value.func) or "")
+            if kind is None:
+                continue
+            for tgt in stmt.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    kinds[tgt.attr] = kind
+    return kinds
+
+
+def _collect_order_edges(
+    cls: ast.ClassDef,
+    lock_attrs: Sequence[str],
+    edges: Dict[Tuple[str, str], Tuple[int, str]],
+) -> None:
+    """Record every lexically nested acquisition pair ``held -> acquired``
+    across ALL methods of ``cls`` (first site wins per pair; the site is
+    the inner ``with``'s line). Multi-item ``with self._a, self._b:``
+    acquires left-to-right, so later items see earlier ones as held."""
+    locks = set(lock_attrs)
+
+    def visit(node: ast.AST, held: Tuple[str, ...],
+              method: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def merely DEFINED under a lock executes later,
+            # without it — its acquisitions are not ordered edges (a
+            # lambda can't contain a `with`, so only defs matter). This
+            # deliberately differs from DTL051's inherit-the-lock-state
+            # rule: there the risk is a torn access IF it runs locked,
+            # here a phantom edge would report a deadlock-free class.
+            for stmt in node.body:
+                visit(stmt, (), method)
+            return
+        if isinstance(node, ast.With):
+            inner = list(held)
+            for item in node.items:
+                visit(item.context_expr, tuple(inner), method)
+                acquired = next(
+                    (lk for lk in locks
+                     if _is_self_attr(item.context_expr, lk)), None
+                )
+                if acquired is not None:
+                    for h in inner:
+                        key = (h, acquired)
+                        if key not in edges:
+                            edges[key] = (node.lineno, method)
+                    inner.append(acquired)
+            for stmt in node.body:
+                visit(stmt, tuple(inner), method)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, method)
+
+    for method in cls.body:
+        if isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for stmt in method.body:
+                visit(stmt, (), method.name)
+
+
+def _cycle_findings(sf: SourceFile, cls: ast.ClassDef,
+                    kinds: Dict[str, Optional[str]],
+                    edges: Dict[Tuple[str, str], Tuple[int, str]],
+                    findings: List[Finding]) -> None:
+    """Tarjan-free SCC-lite: the graphs are tiny (a class owns a handful
+    of locks), so find cycles by checking mutual reachability per pair
+    and self-edges directly."""
+    adj: Dict[str, set] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+
+    def reaches(src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            n = stack.pop()
+            for m in adj.get(n, ()):
+                if m == dst:
+                    return True
+                if m not in seen:
+                    seen.add(m)
+                    stack.append(m)
+        return False
+
+    # self-deadlock: re-acquiring a NON-reentrant lock under itself
+    for (a, b), (line, method) in sorted(edges.items(),
+                                         key=lambda kv: kv[1][0]):
+        if a == b and kinds.get(a) == "Lock":
+            findings.append(Finding(
+                "DTL052", sf.path, line,
+                f"{cls.name}.{method} re-acquires non-reentrant lock "
+                f"`self.{a}` (threading.Lock) while already holding it — "
+                f"a single-thread deadlock; use an RLock only if "
+                f"reentrancy is truly intended",
+                anchor=f"{cls.name}:{a}->{a}",
+            ))
+
+    # order-inversion cycles: report each unordered lock pair once, at
+    # the earliest edge site that participates in the cycle
+    reported = set()
+    for (a, b), (line, method) in sorted(edges.items(),
+                                         key=lambda kv: kv[1][0]):
+        if a == b:
+            continue
+        pair = tuple(sorted((a, b)))
+        if pair in reported:
+            continue
+        if reaches(b, a):
+            reported.add(pair)
+            findings.append(Finding(
+                "DTL052", sf.path, line,
+                f"{cls.name} acquires `self.{b}` while holding "
+                f"`self.{a}` (in {method}) AND `self.{a}` is reachable "
+                f"while holding `self.{b}` elsewhere — a lock-order "
+                f"cycle deadlocks under the right thread interleaving; "
+                f"pick ONE order and declare it",
+                anchor=f"{cls.name}:{'->'.join(pair)}",
+            ))
+
+
 def check(files: Sequence[SourceFile], config,
           full: bool = True) -> List[Finding]:
     findings: List[Finding] = []
@@ -118,6 +285,13 @@ def check(files: Sequence[SourceFile], config,
                     anchor=f"{cls.name}:_GUARDED_BY",
                 ))
                 continue
+            # DTL052: lock-order cycles — any class that OWNS locks is in
+            # scope, _GUARDED_BY table or not
+            kinds = _lock_kinds(cls, table)
+            if kinds:
+                edges: Dict[Tuple[str, str], Tuple[int, str]] = {}
+                _collect_order_edges(cls, list(kinds), edges)
+                _cycle_findings(sf, cls, kinds, edges, findings)
             if not table:
                 continue
             field_to_lock = {

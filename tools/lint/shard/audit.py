@@ -1,0 +1,546 @@
+"""The shard-stage auditor: mesh-aware lowering analysis + contract checking.
+
+For every registered :class:`~.types.ShardEntry` this module
+
+* lowers the program (the entry's thunk — ``fn.lower(...)`` under the
+  entry's mesh; abstract avals, no device execution) and reads the
+  ``@main`` signature: per-argument/per-result ``mhlo.sharding``
+  attributes (what GSPMD is actually handed), explicit ``stablehlo.*``
+  collective ops, and ``custom_call @Sharding`` constraint sites net of
+  shard_map boundary markers (``@SPMDFullToShardShape`` /
+  ``@SPMDShardToFullShape``);
+* for ``partitioned`` entries (multi-device meshes) ALSO compiles the
+  lowered program on the host-platform device mesh and counts the
+  collectives in the post-SPMD-partitioning HLO — the ground truth that
+  includes every all-gather/all-reduce GSPMD *inserted*, which is
+  exactly what the lowered text cannot show.
+
+The per-entry facts are checked against the committed contract file
+(``tools/shard_contracts.json``), yielding DTL15x findings (code table
+in ``tools/lint/shard/__init__.py``). ``emit_contract`` regenerates the
+contract from the current registry — the blessed-update workflow, the
+same shape as the trace stage's.
+
+Collective counts come from COMPILED programs, so they depend on the
+XLA pass pipeline; the audit pins ``jax_disable_most_optimizations``
+(True — the rawest, most deterministic partitioner output, and the
+test suite's own setting) for the duration of every audit and restores
+it after, so the committed counts are identical in-process under
+pytest, under the CLI, and inside the multichip dryrun's provenance
+cross-check.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..core import Finding
+from ..trace.audit import _def_line, _load_registry
+from .types import ShardEntry
+
+# canonical op-kind names (contract keys); left = compiled-HLO spelling,
+# right = lowered-StableHLO spelling
+_COLLECTIVE_OPS: Tuple[Tuple[str, str], ...] = (
+    ("all-gather", "all_gather"),
+    ("all-reduce", "all_reduce"),
+    ("reduce-scatter", "reduce_scatter"),
+    ("collective-permute", "collective_permute"),
+    ("all-to-all", "all_to_all"),
+)
+
+_ARG_RE = re.compile(r"%arg(\d+): (tensor<[^>]*>)")
+_SHARD_RE = re.compile(r'mhlo\.sharding = "([^"]*)"')
+
+
+@contextlib.contextmanager
+def _pinned_compile_flags():
+    """Pin the XLA pipeline knob the collective counts depend on, restore
+    on exit (the audit may run in-process inside pytest or a bench)."""
+    import jax
+
+    prev = bool(jax.config._read("jax_disable_most_optimizations"))
+    jax.config.update("jax_disable_most_optimizations", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_disable_most_optimizations", prev)
+
+
+# --------------------------------------------------------------- parsing
+
+
+def _main_region(text: str) -> Tuple[str, str]:
+    """(argument region, result region) of the lowered module's ``@main``
+    signature. Bracket matching is quote-aware: HLO sharding strings
+    contain unbalanced ``<=`` tokens that would wreck naive depth
+    counting."""
+    start = text.find("@main(")
+    if start < 0:
+        return "", ""
+    i = start + len("@main(")
+    args, j = _balanced(text, i)
+    arrow = text.find("->", j)
+    if arrow < 0:
+        return args, ""
+    k = text.find("(", arrow)
+    newline = text.find("\n", arrow)
+    if k < 0 or (newline >= 0 and k > newline):
+        # single unparenthesized result type
+        end = newline if newline >= 0 else len(text)
+        region = text[arrow + 2:end].strip().rstrip("{").strip()
+        return args, region
+    res, _ = _balanced(text, k + 1)
+    return args, res
+
+
+def _balanced(text: str, i: int) -> Tuple[str, int]:
+    """Text up to the paren that closes the one just before ``i``,
+    skipping quoted strings."""
+    depth, j, in_str = 1, i, False
+    while j < len(text) and depth:
+        c = text[j]
+        if in_str:
+            if c == '"':
+                in_str = False
+        elif c == '"':
+            in_str = True
+        elif c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+        j += 1
+    return text[i:j - 1], j
+
+
+def _split_top(region: str) -> List[str]:
+    """Split a type-list region on top-level commas (quote- and
+    bracket-aware; ``tensor<...>`` angle brackets carry no commas, and
+    sharding strings are inside quotes)."""
+    out, buf, depth, in_str = [], "", 0, False
+    for c in region:
+        if in_str:
+            buf += c
+            if c == '"':
+                in_str = False
+            continue
+        if c == '"':
+            in_str = True
+            buf += c
+            continue
+        if c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+        if c == "," and depth == 0:
+            out.append(buf)
+            buf = ""
+        else:
+            buf += c
+    if buf.strip():
+        out.append(buf)
+    return out
+
+
+def parse_main_shardings(
+    text: str,
+) -> Tuple[List[Optional[str]], List[Optional[str]]]:
+    """Per-argument and per-result ``mhlo.sharding`` strings (None when
+    the attribute is absent) from the lowered ``@main`` signature."""
+    arg_region, res_region = _main_region(text)
+    matches = list(_ARG_RE.finditer(arg_region))
+    args: List[Optional[str]] = []
+    for k, m in enumerate(matches):
+        seg_end = (matches[k + 1].start() if k + 1 < len(matches)
+                   else len(arg_region))
+        seg = arg_region[m.start():seg_end]
+        sh = _SHARD_RE.search(seg)
+        args.append(sh.group(1) if sh else None)
+    outs: List[Optional[str]] = []
+    for seg in _split_top(res_region):
+        sh = _SHARD_RE.search(seg)
+        outs.append(sh.group(1) if sh else None)
+    return args, outs
+
+
+def lowered_collectives(text: str) -> Dict[str, int]:
+    """Explicit collective ops in PRE-partitioning StableHLO — shard_map
+    psums/ppermutes the source wrote. GSPMD-inserted collectives do not
+    exist yet at this level (see :func:`compiled_collectives`)."""
+    out: Dict[str, int] = {}
+    for canon, st in _COLLECTIVE_OPS:
+        n = len(re.findall(rf"stablehlo\.{st}\b", text))
+        if n:
+            out[canon] = n
+    return out
+
+
+def compiled_collectives(text: str) -> Dict[str, int]:
+    """Collective instructions in POST-partitioning compiled HLO (async
+    ``-start`` forms count once; ``-done`` halves don't)."""
+    out: Dict[str, int] = {}
+    for canon, _ in _COLLECTIVE_OPS:
+        # opcode-followed-by-operands; operand REFERENCES (`%all-reduce.3`)
+        # never carry the paren, and tuple-shaped results (`= (f32[..],
+        # f32[..]) all-to-all(`) rule out anchoring on the result type
+        n = len(re.findall(rf"\b{canon}(?:-start)?\(", text))
+        if n:
+            out[canon] = n
+    return out
+
+
+_SHARDING_SITE_RE = re.compile(
+    r"(%[\w.#]+)\s*=\s*stablehlo\.custom_call @Sharding\("
+    r'[^)]*\)\s*\{backend_config = "([^"]*)"'
+)
+_SPMD_MARKER_RE = re.compile(
+    r"@SPMD(?:FullToShardShape|ShardToFullShape)\((%[\w.#]+)"
+)
+
+
+def reshard_constraints(text: str) -> int:
+    """In-program ``@Sharding`` constraint sites NOT attributable to a
+    shard_map boundary. A boundary ``@Sharding``'s SSA result is consumed
+    directly by a ``@SPMDFullToShardShape``/``@SPMDShardToFullShape``
+    marker (jax's shard_map lowering emits the pair on every operand and
+    result, in full-manual and partial-manual mode alike) — those are
+    declared spec boundaries. Markers with a non-empty
+    ``unspecified_dims`` backend config are jax's internal partial-
+    sharding annotations (key arrays, partial-manual operands), not
+    programmer constraints, and are excluded too. What remains is the
+    ``with_sharding_constraint``-shaped reshard point a program declares
+    mid-flight — each one a potential device-to-device copy, so the
+    count is contract-budgeted (DTL154)."""
+    boundary_values = set(_SPMD_MARKER_RE.findall(text))
+    n = 0
+    for value, backend_config in _SHARDING_SITE_RE.findall(text):
+        if backend_config:
+            continue
+        if value in boundary_values:
+            continue
+        n += 1
+    return n
+
+
+def _digest(items: Sequence[Optional[str]]) -> str:
+    joined = "\n".join("-" if x is None else x for x in items)
+    return hashlib.sha1(joined.encode()).hexdigest()[:16]
+
+
+def _spec_repr(spec) -> str:
+    return repr(tuple(spec))
+
+
+# --------------------------------------------------------------- auditing
+
+
+def audit_shard_entry(ep: ShardEntry) -> Dict[str, Any]:
+    """Lower (and for multi-device meshes compile) one entry; return the
+    per-entry report the checkers and ``--emit-contract`` consume."""
+    with _pinned_compile_flags():
+        lowered = ep.lower()
+        text = lowered.as_text()
+        explicit = lowered_collectives(text)
+        if ep.partitioned:
+            level = "partitioned"
+            collectives = compiled_collectives(
+                lowered.compile().as_text()
+            )
+        else:
+            level = "lowered"
+            collectives = dict(explicit)
+
+    actual_in, actual_out = parse_main_shardings(text)
+    # jit drops unused args from the lowered module (keep_unused=False is
+    # the production default — the canonical loss ignores its rng, so
+    # that key never reaches @main); align the EXPECTED per-arg list
+    # through the lowering's kept-variable indices
+    arg_paths = list(ep.arg_paths)
+    in_expected = list(ep.in_shardings)
+    pos_of = {i: i for i in range(len(actual_in))}
+    if in_expected and len(in_expected) != len(actual_in):
+        try:
+            kept = sorted(lowered._lowering.compile_args["kept_var_idx"])
+        except (AttributeError, KeyError, TypeError):
+            kept = None
+        if kept is not None and len(kept) == len(actual_in) \
+                and (not kept or kept[-1] < len(in_expected)):
+            arg_paths = [ep.arg_paths[i] for i in kept]
+            in_expected = [ep.in_shardings[i] for i in kept]
+            pos_of = {orig: p for p, orig in enumerate(kept)}
+    # the intent->arg join is only sound when expected and lowered args
+    # line up 1:1; when they don't (kept_var_idx unavailable on a future
+    # jax), the <arity> DTL152 mismatch below fails the gate LOUDLY and
+    # DTL153 must stay silent rather than misjoin to the wrong args
+    intents_judgeable = (not ep.in_shardings
+                         or len(in_expected) == len(actual_in))
+
+    in_mismatches: List[Tuple[str, str, str]] = []
+    out_mismatches: List[Tuple[str, str, str]] = []
+    if in_expected:
+        if len(in_expected) != len(actual_in):
+            in_mismatches.append((
+                "<arity>", f"{len(in_expected)} args",
+                f"{len(actual_in)} args",
+            ))
+        for path, exp, act in zip(arg_paths, in_expected, actual_in):
+            if exp is not None and act != exp:
+                in_mismatches.append((path, exp, act or "<none>"))
+    if ep.out_shardings:
+        if len(ep.out_shardings) != len(actual_out):
+            out_mismatches.append((
+                "<arity>", f"{len(ep.out_shardings)} results",
+                f"{len(actual_out)} results",
+            ))
+        for path, exp, act in zip(ep.out_paths, ep.out_shardings, actual_out):
+            if exp is not None and act != exp:
+                out_mismatches.append((path, exp, act or "<none>"))
+
+    # DTL153: rule-engine intent said "sharded", the lowered program says
+    # "fully replicated" — join on the flattened argument index. An arg
+    # jit DROPPED (absent from pos_of) never reaches @main at all: that
+    # is unused, not replicated — skip it rather than misreport.
+    replicated_intents: List[Dict[str, Any]] = []
+    for intent in ep.param_intents:
+        if not intents_judgeable or not intent.get("intent_sharded"):
+            continue
+        pos = pos_of.get(intent.get("arg"))
+        if pos is None or pos >= len(actual_in):
+            continue
+        act = actual_in[pos]
+        if act is None or "replicated" in act or "maximal" in act:
+            replicated_intents.append(intent)
+
+    param_specs = {
+        intent["path"]: _spec_repr(intent["spec"])
+        for intent in ep.param_intents
+        if intent.get("intent_sharded")
+    }
+
+    return {
+        "name": ep.name,
+        "path": ep.path,
+        "symbol": ep.symbol,
+        "mesh": dict(ep.mesh_axes),
+        "level": level,
+        "collectives": collectives,
+        "explicit_collectives": explicit,
+        "reshard_constraints": reshard_constraints(text),
+        "in_args": len(actual_in),
+        "out_vals": len(actual_out),
+        "sharded_in_args": sum(
+            1 for s in actual_in
+            if s is not None and "replicated" not in s and "maximal" not in s
+        ),
+        "in_sharding_digest": _digest(actual_in),
+        "out_sharding_digest": _digest(actual_out),
+        "in_mismatches": in_mismatches,
+        "out_mismatches": out_mismatches,
+        "replicated_intents": replicated_intents,
+        "param_specs": param_specs,
+    }
+
+
+# ---------------------------------------------------------- the contract
+
+
+def load_contract(path: str) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "entries" not in data:
+        raise ValueError(
+            f"shard contract {path}: want a JSON object with an "
+            f'"entries" map, got {type(data).__name__}'
+        )
+    return data
+
+
+def emit_contract(reports: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Contract JSON derived from the current registry + audit — commit
+    the output after an INTENTIONAL change (a renegotiated collective
+    budget, a new sharding rule), exactly like re-baselining. What it
+    CANNOT clear: DTL152 lowered-vs-derived drift and DTL153 accidental
+    replication live in the code, not the contract."""
+    entries: Dict[str, Any] = {}
+    for r in sorted(reports, key=lambda r: r["name"]):
+        entries[r["name"]] = {
+            "path": r["path"],
+            "mesh": r["mesh"],
+            "level": r["level"],
+            "collectives": {
+                k: r["collectives"][k] for k in sorted(r["collectives"])
+            },
+            "max_reshard_constraints": r["reshard_constraints"],
+            "in_sharding_digest": r["in_sharding_digest"],
+            "out_sharding_digest": r["out_sharding_digest"],
+            "sharded_in_args": r["sharded_in_args"],
+            "param_specs": {
+                k: r["param_specs"][k] for k in sorted(r["param_specs"])
+            },
+        }
+    return {"version": 1, "entries": entries}
+
+
+def check_reports(
+    reports: List[Dict[str, Any]],
+    contract: Dict[str, Any],
+    contract_path: str,
+    repo_root: str,
+) -> List[Finding]:
+    """Compare audit reports against the committed contract; every
+    divergence is a DTL15x finding anchored on the entry point."""
+    findings: List[Finding] = []
+    entries = contract.get("entries", {})
+    by_name = {r["name"]: r for r in reports}
+
+    def add(code, rep, msg, anchor_suffix=""):
+        findings.append(Finding(
+            code=code,
+            path=rep["path"],
+            line=_def_line(repo_root, rep["path"], rep["symbol"]),
+            message=msg,
+            anchor=rep["name"] + anchor_suffix,
+        ))
+
+    # ---- DTL155: registry <-> contract 1:1 (the DTL101/102 mirror) ----
+    for name in sorted(set(entries) - set(by_name)):
+        findings.append(Finding(
+            code="DTL155", path=contract_path, line=1,
+            message=f"contract entry '{name}' matches no registered shard "
+                    f"entry point — prune it (the contract, like the "
+                    f"baseline, can only track live code)",
+            anchor=name,
+        ))
+
+    for rep in reports:
+        name = rep["name"]
+        c = entries.get(name)
+        if c is None:
+            add("DTL155", rep,
+                f"shard entry point '{name}' has no committed contract "
+                f"entry — run `python tools/lint.py --shard "
+                f"--emit-contract` and review the diff")
+            continue
+
+        # ---- DTL151: per-op-kind collective budget --------------------
+        budget = c.get("collectives", {})
+        for op in sorted(rep["collectives"]):
+            n = rep["collectives"][op]
+            if op not in budget:
+                add("DTL151", rep,
+                    f"'{name}' ({rep['level']}) contains {n} {op} "
+                    f"collective(s) the contract does not list — an "
+                    f"unlisted collective is the silent-resharding bug "
+                    f"class: HBM and ICI pay for it on every step",
+                    anchor_suffix=f":{op}")
+            elif n > budget[op]:
+                add("DTL151", rep,
+                    f"'{name}' ({rep['level']}) contains {n} {op} "
+                    f"collective(s), contract budget is {budget[op]} — "
+                    f"the program grew communication; if intentional, "
+                    f"re-emit the contract", anchor_suffix=f":{op}")
+
+        # ---- DTL152: in/out sharding-spec contract --------------------
+        mismatches = rep["in_mismatches"] + rep["out_mismatches"]
+        if mismatches:
+            head = "; ".join(
+                f"{p}: rules derive {e}, lowered program carries {a}"
+                for p, e, a in mismatches[:3]
+            )
+            more = len(mismatches) - 3
+            add("DTL152", rep,
+                f"'{name}' lowered arg/result shardings drift from the "
+                f"specs parallel/sharding.py derives ({len(mismatches)} "
+                f"mismatch(es): {head}"
+                + (f"; +{more} more" if more > 0 else "") + ") — the "
+                f"rule engine and what GSPMD is handed no longer agree",
+                anchor_suffix=":lowered")
+        drift = []
+        if rep["in_sharding_digest"] != c.get("in_sharding_digest"):
+            drift.append("in-sharding digest")
+        if rep["out_sharding_digest"] != c.get("out_sharding_digest"):
+            drift.append("out-sharding digest")
+        if rep["sharded_in_args"] != c.get("sharded_in_args"):
+            drift.append(
+                f"sharded-arg count {rep['sharded_in_args']} != "
+                f"{c.get('sharded_in_args')}"
+            )
+        committed_specs = c.get("param_specs", {})
+        if rep["param_specs"] != committed_specs:
+            changed = sorted(
+                set(rep["param_specs"].items())
+                ^ set(committed_specs.items())
+            )
+            drift.append(
+                "param specs "
+                + ", ".join(f"{k}={v}" for k, v in changed[:3])
+                + (f" +{len(changed) - 3} more" if len(changed) > 3 else "")
+            )
+        if drift:
+            add("DTL152", rep,
+                f"'{name}' sharding contract drift vs {contract_path}: "
+                + "; ".join(drift) + " — if the rule change is "
+                f"intentional, re-emit the contract",
+                anchor_suffix=":contract")
+
+        # ---- DTL153: accidental replication ---------------------------
+        for intent in rep["replicated_intents"]:
+            add("DTL153", rep,
+                f"'{name}' parameter {intent['path']} is declared sharded "
+                f"by rule {intent.get('rule')!r} "
+                f"(requested {_spec_repr(intent['requested'])}) but the "
+                f"lowered program replicates it — the fsdp/tp memory "
+                f"story is fiction for this parameter",
+                anchor_suffix=f":{intent['path']}")
+
+        # ---- DTL154: in-program reshard constraints -------------------
+        max_cons = c.get("max_reshard_constraints", 0)
+        if rep["reshard_constraints"] > max_cons:
+            add("DTL154", rep,
+                f"'{name}' contains {rep['reshard_constraints']} "
+                f"in-program sharding-constraint site(s) (net of "
+                f"shard_map boundaries), budget {max_cons} — each "
+                f"unbudgeted constraint is a potential device-to-device "
+                f"reshard copy inside the hot program")
+
+    return findings
+
+
+# ------------------------------------------------------------ the runner
+
+
+def run_shard(
+    repo_root: str,
+    registry_path: str,
+    contract_path: str,
+) -> Tuple[List[Finding], List[Dict[str, Any]]]:
+    """The ``--shard`` stage: load the registry, audit every entry, check
+    against the contract. Returns (findings, reports); findings feed the
+    shared suppression/baseline machinery in ``core.run_lint``."""
+    # contract problems are knowable in microseconds — check BEFORE the
+    # multi-second lower/compile sweep
+    ab_contract = (contract_path if os.path.isabs(contract_path)
+                   else os.path.join(repo_root, contract_path))
+    if not os.path.exists(ab_contract):
+        raise OSError(
+            f"shard contract file {contract_path} not found — generate "
+            f"it with `python tools/lint.py --shard --emit-contract > "
+            f"{contract_path}`"
+        )
+    contract = load_contract(ab_contract)
+    mod = _load_registry(repo_root, registry_path)
+    eps: List[ShardEntry] = mod.build_entry_points()
+    reports = [audit_shard_entry(ep) for ep in eps]
+    rel_contract = contract_path.replace(os.sep, "/")
+    findings = check_reports(reports, contract, rel_contract, repo_root)
+    return findings, reports
+
+
+def shard_reports_only(repo_root: str, registry_path: str):
+    """Audit without a contract (``--emit-contract`` path)."""
+    mod = _load_registry(repo_root, registry_path)
+    return [audit_shard_entry(ep) for ep in mod.build_entry_points()]

@@ -67,21 +67,26 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def lint_preflight(label: str = "serve smoke") -> int:
-    """Static-analysis pre-flight (docs/DESIGN.md §11), two stages in
-    escalation order: first the AST stage alone (``lint.py --check`` —
-    stdlib-only, so a corrupt tree still fails in milliseconds), then
-    the full composition with the TRACE stage (``lint.py --trace
-    --check``): every serving jit this gate is about to drive must
-    match its committed compile-signature/donation/readback/HBM
-    contract (tools/trace_contracts.json) BEFORE a request is admitted.
+    """Static-analysis pre-flight (docs/DESIGN.md §11), all three lint
+    stages in escalation order: first the AST stage alone (``lint.py
+    --check`` — stdlib-only, so a corrupt tree still fails in
+    milliseconds), then the TRACE + SHARD composition (``lint.py
+    --trace --shard --check``, one subprocess — the CLI composes both
+    contract stages in one exit code, so the preflight pays one
+    jax+package import, not two): every serving jit this gate is about
+    to drive must match its committed compile-signature/donation/
+    readback/HBM contract (tools/trace_contracts.json) AND hold the
+    committed "no collectives in serving" baseline, with the train step
+    holding its per-mesh-kind collective/sharding contract
+    (tools/shard_contracts.json), BEFORE a request is admitted.
     Subprocesses on purpose: the AST stage must not inherit this
-    process's jax initialization, and the trace stage re-imports the
+    process's jax initialization, and the contract stages re-import the
     package fresh so a broken import fails the gate, not the drill."""
     import subprocess
 
     for stage, args in (
         ("lint", ["--check"]),
-        ("trace-lint", ["--trace", "--check"]),
+        ("contract-lint", ["--trace", "--shard", "--check"]),
     ):
         proc = subprocess.run(
             [sys.executable, str(REPO / "tools" / "lint.py"), *args],

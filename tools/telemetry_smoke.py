@@ -97,13 +97,14 @@ def main(argv=None) -> int:
 
     import serve_smoke
 
-    # static-analysis pre-flight (docs/DESIGN.md §11), BOTH stages: the
-    # AST lint fails a corrupt tree fast, and the trace stage
+    # static-analysis pre-flight (docs/DESIGN.md §11), ALL THREE stages:
+    # the AST lint fails a corrupt tree fast, the trace stage
     # (`lint.py --trace --check`) holds the serving jits to their
-    # committed compile-signature/donation/readback/HBM contracts before
-    # the recorder or any engine exists. serve_smoke would also run it,
-    # but this gate must fail even when a future refactor stops
-    # composing the two.
+    # committed compile-signature/donation/readback/HBM contracts, and
+    # the shard stage (`lint.py --shard --check`) to the committed
+    # no-collectives-in-serving baseline, before the recorder or any
+    # engine exists. serve_smoke would also run it, but this gate must
+    # fail even when a future refactor stops composing the two.
     if serve_smoke.lint_preflight(label="telemetry smoke") != 0:
         return 1
 
