@@ -2153,26 +2153,115 @@ def _scan_step_time(step, state, batch_data, k_small: int = 5, k_big: int = 25,
 
 
 def bench_sparse_patterns(on_cpu: bool):
-    """Per-pattern flagship train-step time — the reference's entire reason
-    for conv/axial/block-sparse attention is COST reduction
+    """Per-pattern flagship train-step time PLUS the structural block-skip
+    ledger — the reference's entire reason for conv/axial/block-sparse
+    attention is COST reduction
     (/root/reference/dalle_pytorch/attention.py:90-384, README's sparse
     training runs), so each pattern must be measured against full attention,
-    not just proven numerically equivalent. Uniform depth-12 stacks isolate
-    each pattern's cost; speedup_vs_full > 1 means the pattern is earning
-    its keep at the flagship shape."""
+    not just proven numerically equivalent.
+
+    BENCH_r05 measured the sparse patterns at 0.97-0.99x full at seq 1280
+    because masks.py fed dense masks to a dense kernel — the mask zeroed
+    FLOPs it still paid for. The block-sparse Pallas kernel
+    (ops/block_sparse_attention.py) skips dead (q, k) block pairs
+    outright, so each timing record now carries its compiled layout's
+    ``visited_block_frac`` — the FLOP ratio the pair-grid actually
+    executes — and the seq sweep extends the ledger to 2048/4096 where
+    skipping pays more. Two things are ASSERTED in-bench (structure is
+    checkable on any host): every sparse layout visits strictly fewer
+    block pairs than dense-causal, and the kernel (interpret mode — the
+    same trace the TPU lowering uses, minus Mosaic) agrees with the
+    shared-einsum reference at the flagship seq. Wall-clock kernel wins
+    are TPU-pending: on CPU the kernel is gated off
+    (DALLE_TPU_SPARSE_KERNEL auto = TPU only), so the timed steps below
+    measure the dense-mask path."""
+    from dalle_pytorch_tpu.ops import block_sparse_attention as bs
+    from dalle_pytorch_tpu.ops.masks import causal_mask, pattern_mask
+
     batch = 2 if on_cpu else BATCH
     depth = 2 if on_cpu else DEPTH
     n_steps = 3 if on_cpu else 20
+    # per-pattern mask kwargs + whether the pair grid is expected to
+    # engage (ops/block_sparse_attention.ENGAGE_FRAC). axial_col's live
+    # stride (fmap) is finer than the 128-block edge at every geometry
+    # here, so every block pair stays live and the kernel DECLINES — that
+    # is asserted too, because silently engaging on a frac-1.0 layout is
+    # the overhead-for-nothing failure mode. "sparse" only block-skips
+    # when its DeepSpeed-style layout block matches the MXU grid, so the
+    # ledger measures it at block_size=128 (the long-context serving
+    # configuration); the 16-block default peppers every 128-pair.
+    patterns = ("axial_row", "axial_col", "conv_like", "sparse")
+    cases = {
+        "axial_row": ({}, True),
+        "axial_col": ({}, False),
+        "conv_like": ({}, True),
+        "sparse": (dict(block_size=128), True),
+    }
+
+    # structural ledger: one compiled BlockLayout per (pattern, seq). The
+    # sweep geometries keep text_len = n - fmap^2 so the total is exactly
+    # the 128-divisible n the kernel's block grid wants; 2048/4096 are the
+    # long-context shapes ROADMAP item 3 targets.
+    sweep = ((1280, 32), (2048, 42), (4096, 62))
+    layouts = {}
+    for n, fmap in sweep:
+        text_len = n - fmap * fmap
+        dense_elems = float(causal_mask(n).sum())
+        for pattern in patterns:
+            kwargs, engages = cases[pattern]
+            mask = pattern_mask(pattern, text_len, fmap, **kwargs)
+            lay = bs.compile_block_layout(mask, 128, 128)
+            # the bench IS the gate: an engaging layout that fails to
+            # skip block pairs is exactly the BENCH_r05 regression this
+            # kernel exists to fix
+            if engages:
+                assert lay.n_pairs < lay.dense_pairs, (
+                    f"{pattern}@seq{n}: visited {lay.n_pairs} >= "
+                    f"dense-causal {lay.dense_pairs} block pairs — block "
+                    f"skipping is not engaging"
+                )
+            assert (lay.visited_block_frac <= bs.ENGAGE_FRAC) == engages, (
+                f"{pattern}@seq{n}: frac {lay.visited_block_frac:.3f} "
+                f"routes {'into' if not engages else 'away from'} the "
+                f"pair grid — the engage expectation drifted"
+            )
+            layouts[(pattern, n)] = (lay, float(mask.sum()) / dense_elems)
+
+    # kernel-vs-reference agreement, pinned at the flagship seq with small
+    # b/h so the interpret sweep stays CPU-tier safe
+    rng = np.random.RandomState(0)
+    n_par = sweep[0][0]
+    parity = {}
+    for pattern in patterns:
+        lay, _ = layouts[(pattern, n_par)]
+        q, k, v = (
+            jnp.asarray(rng.randn(1, 2, n_par, DIM_HEAD), jnp.float32)
+            for _ in range(3)
+        )
+        out = bs.block_sparse_attention(
+            q, k, v, lay, sm_scale=DIM_HEAD**-0.5, interpret=True
+        )
+        ref = bs.reference_attend(q, k, v, lay, sm_scale=DIM_HEAD**-0.5)
+        err = float(jnp.max(jnp.abs(out - ref)))
+        assert err < 2e-5, (
+            f"{pattern}@seq{n_par}: block-sparse kernel diverges from the "
+            f"shared-einsum reference (max err {err})"
+        )
+        parity[pattern] = err
 
     results = []
     _, state, step, batch_data = build(batch, depth)
     full_time, _ = _time_steps(step, state, batch_data, 3, n_steps)
     del state, step
 
-    for pattern in ("axial_row", "axial_col", "conv_like", "sparse"):
+    kernel_on = bs.sparse_kernel_enabled()
+    for pattern in patterns:
+        kwargs, engages = cases[pattern]
         _, state, step, batch_data = build(batch, depth, attn_types=(pattern,))
         step_time, loss = _time_steps(step, state, batch_data, 3, n_steps)
         del state, step
+        lay, elem_frac = layouts[(pattern, n_par)]
+        active = kernel_on and engages
         results.append({
             "metric": f"train_step_time_attn_{pattern}",
             "value": round(step_time * 1e3, 2),
@@ -2180,11 +2269,45 @@ def bench_sparse_patterns(on_cpu: bool):
             "vs_baseline": None,
             "full_attn_step_time_ms": round(full_time * 1e3, 2),
             "speedup_vs_full": round(full_time / step_time, 3),
+            "visited_block_frac": round(lay.visited_block_frac, 4),
+            "element_mask_density": round(elem_frac, 4),
+            "kernel_reference_max_err": parity[pattern],
+            "kernel_engages": engages,
+            "mask_kwargs": kwargs or None,
+            "sparse_kernel_active": bool(active),
+            "wall_clock_note": None if active else (
+                "pair grid declines on a frac-1.0 layout — dense-mask "
+                "path measured" if not engages else
+                "sparse kernel gated to TPU — timed steps measure the "
+                "dense-mask path; the block-skip wall-clock win is "
+                "TPU-pending (visited_block_frac is its measured FLOP "
+                "ratio)"
+            ),
             "batch": batch,
             "depth": depth,
             "device": jax.devices()[0].device_kind,
             "loss": round(loss, 4),
         })
+
+    for n, fmap in sweep:
+        for pattern in patterns:
+            kwargs, engages = cases[pattern]
+            lay, elem_frac = layouts[(pattern, n)]
+            results.append({
+                "metric": f"block_skip_visited_frac_{pattern}_seq{n}",
+                "value": round(lay.visited_block_frac, 4),
+                "unit": "fraction_of_dense_causal_block_pairs",
+                "vs_baseline": None,
+                "n_pairs": lay.n_pairs,
+                "dense_pairs": lay.dense_pairs,
+                "element_mask_density": round(elem_frac, 4),
+                "block": lay.block_q,
+                "kernel_engages": engages,
+                "mask_kwargs": kwargs or None,
+                "text_len": n - fmap * fmap,
+                "image_fmap": fmap,
+                "device": "structural",
+            })
     return results
 
 

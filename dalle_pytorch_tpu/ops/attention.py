@@ -67,6 +67,70 @@ def _cached_flash_mask(module: "PatternAttention", n: int) -> StaticMask:
     return cached
 
 
+_BLOCK_LAYOUT_CACHE: dict = {}
+_SP_PLAN_CACHE: dict = {}
+
+
+def _pattern_key(module: "PatternAttention", n: int) -> tuple:
+    """The hashable pattern-config key (the `_cached_flash_mask` rule:
+    key on the fields ``pattern_mask()`` reads, never the bound module)."""
+    return (
+        module.attn_type, module.seq_len, module.causal,
+        module.image_fmap_size, module.kernel_size, module.dilation,
+        module.block_size, module.num_random_blocks, module.layout_seed, n,
+    )
+
+
+def _cached_block_layout(
+    module: "PatternAttention", n: int, block: int
+) -> "bs_lib.BlockLayout":
+    """One compiled BlockLayout per (pattern config, n, block), built once:
+    BlockLayout hashes by identity, so jit/custom_vjp retrace only when the
+    layout genuinely changes."""
+    from . import block_sparse_attention as bs_lib
+
+    key = _pattern_key(module, n) + (block,)
+    cached = _BLOCK_LAYOUT_CACHE.get(key)
+    if cached is None:
+        cached = _BLOCK_LAYOUT_CACHE[key] = bs_lib.compile_block_layout(
+            module.pattern_mask()[:n, :n], block, block
+        )
+    return cached
+
+
+def _sparse_block(n: int) -> int:
+    """Kernel-eligible block edge for the pair-grid sparse kernel: lanes
+    must be a multiple of 128 and per-step overhead dominates below it
+    (the flash kernel's measured floor), so eligibility is simply n
+    divisible by 128 with at least two blocks — the production seqs
+    (1280/2048/4096) all qualify; everything else keeps the dense paths."""
+    return 128 if n % 128 == 0 and n >= 256 else 0
+
+
+def _sp_plan_block(n: int, sp: int) -> int:
+    """Assignment granularity for the dual-balanced sp plan: the kernel
+    edge when eligible, else the largest power-of-two divisor of n that
+    still gives every chip a shot at >= 1 block (CPU test shapes)."""
+    for b in (128, 64, 32, 16, 8, 4, 2, 1):
+        if n % b == 0 and n // b >= sp:
+            return b
+    return 1
+
+
+def _cached_sp_plan(module: "PatternAttention", n: int, sp: int):
+    """One dual-balanced SpPlan per (pattern config, n, sp)."""
+    from . import block_sparse_attention as bs_lib
+
+    block = _sp_plan_block(n, sp)
+    key = _pattern_key(module, n) + (sp, block)
+    cached = _SP_PLAN_CACHE.get(key)
+    if cached is None:
+        cached = _SP_PLAN_CACHE[key] = bs_lib.compile_sp_plan(
+            _cached_block_layout(module, n, block), sp
+        )
+    return cached
+
+
 @functools.lru_cache(maxsize=None)
 def _cached_rot_slice(table: StaticTable, n: int) -> StaticTable:
     """Stable-identity [:n] slice of a static rotary table (the fused
@@ -325,6 +389,36 @@ class PatternAttention(nn.Module):
                 and not self.is_initializing()
                 and sp_extent(self.sp_axis) > 1
             )
+            # pair-grid block-sparse kernel (ops/block_sparse_attention.py):
+            # the grid visits only live block pairs, so — unlike the packed
+            # flash path below, whose affine index maps still DMA every
+            # block — sparse patterns stop paying dense memory traffic.
+            # Policy-gated (auto = TPU): the dense-mask paths stay the
+            # fallback and the parity oracle.
+            use_block_sparse = False
+            if (
+                not use_sp
+                and not force_dense
+                and self.attn_type != "full"
+                and _sparse_block(n) > 0
+            ):
+                from .block_sparse_attention import (
+                    ENGAGE_FRAC,
+                    sparse_kernel_enabled,
+                )
+
+                if sparse_kernel_enabled():
+                    # engage only when the COMPILED layout actually skips
+                    # block pairs: a pattern whose live stride is finer
+                    # than the 128-block edge (axial_col at fmap <= 128,
+                    # the 16-block DeepSpeed-style random layout) visits
+                    # every causal pair — the pair grid would pay kernel
+                    # overhead for zero skipped FLOPs, so it declines and
+                    # the dense/flash paths keep those patterns
+                    layout = _cached_block_layout(self, n, _sparse_block(n))
+                    use_block_sparse = (
+                        layout.visited_block_frac <= ENGAGE_FRAC
+                    )
             # packed single-block path: q/k/v head slices stream straight
             # out of the projection layout, rotary applied in-kernel — no
             # split/reshape/transpose/rotary sweeps through HBM. EVERY
@@ -336,6 +430,7 @@ class PatternAttention(nn.Module):
             # _pattern_attend below)
             if (
                 not use_sp
+                and not use_block_sparse
                 and self.use_flash
                 and not force_dense
                 and _flash_block(n) == n
@@ -369,6 +464,8 @@ class PatternAttention(nn.Module):
 
             if use_sp:
                 out = self._sp_attend(q, k, v, mask, n)
+            elif use_block_sparse:
+                out = self._block_sparse_attend(q, k, v, n, mask)
             elif (
                 self.use_flash
                 and not force_dense
@@ -409,14 +506,38 @@ class PatternAttention(nn.Module):
             interpret=jax.devices()[0].platform != "tpu",
         )
 
+    # ----------------------------------------------------- block-sparse path
+
+    def _block_sparse_attend(self, q, k, v, n: int, mask=None):
+        """Pair-grid block-sparse kernel (ops/block_sparse_attention.py):
+        the compiled BlockLayout's live pairs ARE the grid, so masked
+        blocks cost neither DMA nor FLOPs — the path that makes the
+        sparse patterns pay at seq >= 2048. Interpret mode off-TPU, where
+        the CPU parity tier pins it allclose against the dense-mask
+        reference per layout (tests/test_block_sparse.py)."""
+        from .block_sparse_attention import block_sparse_attention
+
+        layout = _cached_block_layout(self, n, _sparse_block(n))
+        return block_sparse_attention(
+            q, k, v, layout,
+            key_mask=None if mask is None else mask[:, :n],
+            sm_scale=self.dim_head**-0.5,
+            interpret=jax.devices()[0].platform != "tpu",
+        )
+
     # -------------------------------------------------- sequence parallelism
 
     def _sp_attend(self, q, k, v, mask, n: int):
-        """Sequence-parallel attention over the ``sp_axis`` mesh axis
-        (ops/ring_attention.py): ring attention for the dense-causal pattern,
-        Ulysses all-to-all for every other pattern. The surrounding network
-        stays GSPMD-sharded; only this core runs under shard_map. The
-        reference has no sequence parallelism at all (SURVEY.md §5.7)."""
+        """Sequence-parallel attention over the ``sp_axis`` mesh axis:
+        ring attention for the dense-causal pattern
+        (ops/ring_attention.py), the DUAL-BALANCED block plan for the
+        sparse patterns (ops/block_sparse_attention.py — q-blocks dealt to
+        chips so both block and visited-pair counts are even; an axial
+        pattern's heavy text rows no longer serialize the slowest chip),
+        and Ulysses all-to-all for the non-causal full pattern (CLIP's
+        encoders — uniform rows, nothing to balance). The surrounding
+        network stays GSPMD-sharded; only this core runs under shard_map.
+        The reference has no sequence parallelism at all (SURVEY.md §5.7)."""
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.context import active_mesh, batch_axes
@@ -439,6 +560,35 @@ class PatternAttention(nn.Module):
                 return ring_attention(
                     q, k, v, self.sp_axis, sp,
                     causal=True, sm_scale=scale, key_mask=km,
+                )
+
+        elif self.attn_type in ("axial_row", "axial_col", "conv_like", "sparse"):
+            from .block_sparse_attention import (
+                sp_block_sparse_attend,
+                sparse_kernel_enabled,
+            )
+
+            plan = _cached_sp_plan(self, n, sp)
+            # chip-local compute rides the pair kernel at kernel-eligible
+            # shapes (the chip tables are traced operands selected by
+            # axis_index inside the body); dense-mask jnp otherwise
+            from .block_sparse_attention import ENGAGE_FRAC
+
+            use_kernel = (
+                plan.layout.block_q == _sparse_block(n) != 0
+                and plan.rows_per_chip % 128 == 0
+                and plan.layout.visited_block_frac <= ENGAGE_FRAC
+                and sparse_kernel_enabled()
+            )
+            interp = jax.devices()[0].platform != "tpu"
+            stable = self.stable
+            sp_axis = self.sp_axis
+
+            def body(q, k, v, km=None):
+                return sp_block_sparse_attend(
+                    q, k, v, plan, sp_axis, sp,
+                    sm_scale=scale, key_mask=km,
+                    use_kernel=use_kernel, interpret=interp, stable=stable,
                 )
 
         else:

@@ -660,6 +660,43 @@ def _copy_pages_across_jit(dst_cache, src_cache, src, dst, valid):
     return jax.tree_util.tree_map_with_path(fn, dst_cache, src_cache)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _map_prefix_jit(cache, idx, ids, n_ids, offset, ring):
+    """Prefix-hit publish/map as ONE donated fixed-shape dispatch (the
+    PR 10 follow-on finishing what ``_copy_pages_jit`` started): the
+    eager per-admission ``.at[].set`` leaf rewrites (page-table row,
+    cache/shift indices, shift-ring seam) now ride a single jit shared by
+    all three admission shapes — fused partial-hit map, split-mode
+    batch-1 seeding (``n_ids == 0``: the page-table update is a no-op),
+    and the full-hit map — so the zero-in-trace-compile contract holds by
+    construction (DTL11x; registry entries ``serving.prefix_map`` /
+    ``serving.prefix_map_quant``). ``ids`` is padded to the fixed
+    page-table row width with ``n_ids`` real entries; ``ring`` is the
+    terminal node's keystr-keyed shift-ring dict, traced as a pytree.
+    The cache is donated, and every output leaf is a DISTINCT buffer by
+    XLA's output-buffer rules — which is also what makes the split-mode
+    seeding safe once the chunk jits donate the batch-1 cache (the old
+    eager path had to build per-leaf fresh index arrays by hand)."""
+
+    def fn(path, x):
+        key = getattr(path[-1], "key", None)
+        if key == "page_table":
+            row = x[idx]
+            pos = jnp.arange(row.shape[-1], dtype=jnp.int32)
+            return x.at[idx].set(
+                jnp.where(pos < n_ids, ids[: row.shape[-1]], row)
+            )
+        if key in ("cache_index", "shift_index"):
+            return x.at[idx].set(jnp.asarray(offset, x.dtype))
+        if key == "shift_hist":
+            return x.at[idx].set(
+                ring[jax.tree_util.keystr(path)].astype(x.dtype)
+            )
+        return x
+
+    return jax.tree_util.tree_map_with_path(fn, cache)
+
+
 def _append_arena_rows(cache, rows: int):
     """Append ``rows`` zeroed storage rows to every K/V page-pool leaf —
     the prefix cache's arena. Tables, indices, and shift rings stay at
@@ -876,6 +913,12 @@ class Engine:
                 list(arena_ids), self.page,
                 format_tag=self._kv_format_tag(),
             )
+        # the pristine init tree's index leaves alias one buffer
+        # (set_decode_offsets hands cache_index and shift_index the same
+        # offsets array). Every path that donates the batched cache
+        # (_map_prefix_jit at admission, the fused iteration jit) forbids
+        # aliased inputs; one copy de-aliases the tree once
+        self.cache = jax.tree_util.tree_map(jnp.copy, self.cache)
         self._prefix_hits = 0
         self._prefix_misses = 0
         # pristine batch-1 cache, the TEMPLATE every prefill starts from.
@@ -936,12 +979,6 @@ class Engine:
                 )
             self._W = fused_width(config)
             self._prompts = jnp.zeros((B, self.T), jnp.int32)
-            # the fused jit donates the cache on its FIRST dispatch, when
-            # it is still the pristine init tree — whose index leaves
-            # alias one buffer (set_decode_offsets hands cache_index and
-            # shift_index the same offsets array). Donation forbids
-            # aliased inputs; one copy de-aliases the tree once
-            self.cache = jax.tree_util.tree_map(jnp.copy, self.cache)
         # speculative-decode state: lifetime draft/accept tallies (the
         # serve.spec_accept_frac gauge) and the per-slot BASE sampling
         # keys — key(seed), written once per admission; the spec jit
@@ -1296,47 +1333,25 @@ class Engine:
             # from the prompts buffer — one small row write per admission
             self._prompts = self._prompts.at[idx].set(internal[0])
             if nodes:
-                ids = jnp.asarray(
-                    [n.page_id for n in nodes], jnp.int32
+                ids = np.zeros(self.n_pages_slot, np.int32)
+                ids[: len(nodes)] = [n.page_id for n in nodes]
+                self.cache = _map_prefix_jit(
+                    self.cache, np.int32(idx), jnp.asarray(ids),
+                    np.int32(len(nodes)), np.int32(s), nodes[-1].ring,
                 )
-                ring = nodes[-1].ring
-
-                def fn(path, x):
-                    key = getattr(path[-1], "key", None)
-                    if key == "page_table":
-                        return x.at[idx, : len(nodes)].set(ids)
-                    if key in ("cache_index", "shift_index"):
-                        return x.at[idx].set(s)
-                    if key == "shift_hist":
-                        return x.at[idx].set(
-                            ring[jax.tree_util.keystr(path)]
-                        )
-                    return x
-
-                self.cache = jax.tree_util.tree_map_with_path(fn, self.cache)
                 slot.shared_nodes = list(nodes)
         else:
             slot.cache1 = self._fresh_prefill_cache()
             slot.internal = internal
             if nodes:
                 src = [n.page_id for n in nodes]
-                ring = nodes[-1].ring
-
-                def fn(path, x1):
-                    key = getattr(path[-1], "key", None)
-                    if key == "shift_hist":
-                        return x1.at[0].set(
-                            ring[jax.tree_util.keystr(path)]
-                        )
-                    if key in ("cache_index", "shift_index"):
-                        # per-leaf fresh arrays (set_decode_offsets would
-                        # hand EVERY index leaf the same buffer — fatal
-                        # once the chunk jits donate this cache)
-                        return jnp.full((1,), s, x1.dtype)
-                    return x1
-
-                slot.cache1 = jax.tree_util.tree_map_with_path(
-                    fn, slot.cache1
+                # seam + index seeding through the shared donated map jit
+                # (page-table no-op: n_ids == 0 — the pages arrive via the
+                # cross-pool copy below, already slot-local)
+                slot.cache1 = _map_prefix_jit(
+                    slot.cache1, np.int32(0),
+                    jnp.zeros(self.n_pages_slot, jnp.int32),
+                    np.int32(0), np.int32(s), nodes[-1].ring,
                 )
                 # arena -> batch-1 pool restore through the donated
                 # fixed-shape cross-pool copy jit (full pages: valid ==
@@ -1387,22 +1402,15 @@ class Engine:
         terminal = nodes[-1]
         cow = terminal.valid < self.page
         shared = nodes[:-1] if cow else list(nodes)
-        ids = jnp.asarray([n.page_id for n in shared], jnp.int32)
-        ring = terminal.ring
         n_p = self.n_pages_slot
         T = self.T
 
-        def fn(path, x):
-            key = getattr(path[-1], "key", None)
-            if key == "page_table":
-                return x.at[idx, : len(shared)].set(ids) if len(shared) else x
-            if key in ("cache_index", "shift_index"):
-                return x.at[idx].set(T)
-            if key == "shift_hist":
-                return x.at[idx].set(ring[jax.tree_util.keystr(path)])
-            return x
-
-        self.cache = jax.tree_util.tree_map_with_path(fn, self.cache)
+        ids = np.zeros(n_p, np.int32)
+        ids[: len(shared)] = [n.page_id for n in shared]
+        self.cache = _map_prefix_jit(
+            self.cache, np.int32(idx), jnp.asarray(ids),
+            np.int32(len(shared)), np.int32(T), terminal.ring,
+        )
         if cow:
             # the map-time COW rides the donated fixed-shape copy jit —
             # one warm dispatch, not an eager pool-sized rewrite

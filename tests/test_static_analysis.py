@@ -774,13 +774,14 @@ class TestShard:
         """The committed repo contract IS the 'no collectives in
         serving' baseline ROADMAP item 1 will renegotiate: every
         serving.* entry must budget an empty collective map, and the
-        six train.* mesh-kind entries must all be present."""
+        seven train.* mesh-kind entries must all be present (sp lowers
+        twice — ring path and dual-balanced block-sparse path)."""
         committed = json.loads(
             (REPO / "tools" / "shard_contracts.json").read_text()
         )
         entries = committed["entries"]
         kinds = {n.split(".", 1)[1] for n in entries if n.startswith("train.")}
-        assert kinds == {"dp", "fsdp", "tp", "sp", "pp", "ep"}
+        assert kinds == {"dp", "fsdp", "tp", "sp", "sp_sparse", "pp", "ep"}
         serving = [n for n in entries if n.startswith("serving.")]
         assert len(serving) >= 10
         for name in serving:

@@ -4,9 +4,11 @@ This module — like the trace registry it is modeled on — IMPORTS the
 package, because its job is to build the REAL programs production runs:
 
 * ``make_train_step`` (donated state, NaN guard on, the canonical
-  weighted-CE loss) lowered under each of the six mesh kinds from
-  ``parallel/mesh.py`` (``dp``/``fsdp``/``tp``/``sp``/``pp``/``ep``),
-  each as a 2-extent axis over the first two host-platform devices —
+  weighted-CE loss) lowered under the six mesh kinds from
+  ``parallel/mesh.py`` (``dp``/``fsdp``/``tp``/``sp``/``pp``/``ep``)
+  as seven entries — ``sp`` lowers twice, ring path and dual-balanced
+  block-sparse path — each a 2-extent axis over the first two
+  host-platform devices —
   abstract lowering plus one host-CPU compile per mesh, no TPU
   anywhere. The model is the trace stage's canonical config, varied only
   where an axis demands structure (``sp`` needs a ring-splittable
@@ -39,15 +41,23 @@ _STEP_PATH = "dalle_pytorch_tpu/parallel/step.py"
 
 # per-mesh-kind model variation: an axis only exercises its collectives
 # when the model has the structure the axis shards (mirrors the
-# __graft_entry__.py dryrun configs)
+# __graft_entry__.py dryrun configs). Rows are (entry_name, axis,
+# model_kw, moe) — entry_name diverges from the axis when one axis is
+# audited under more than one model structure: ``sp`` lowers twice,
+# once on the ring path (full+axial_row) and once on the dual-balanced
+# block-sparse path (axial_row+sparse), because the two paths have
+# different collective contracts (permutes vs all-gathers).
 MESH_KINDS = (
-    ("dp", {}, False),
-    ("fsdp", {}, False),
-    ("tp", {}, False),
-    ("sp", dict(attn_types=("full", "axial_row"), sp_axis="sp",
-                text_seq_len=8, image_fmap_size=4), False),
-    ("pp", dict(pp_axis="pp"), False),
-    ("ep", dict(ff_experts=4, moe_every=1), True),
+    ("dp", "dp", {}, False),
+    ("fsdp", "fsdp", {}, False),
+    ("tp", "tp", {}, False),
+    ("sp", "sp", dict(attn_types=("full", "axial_row"), sp_axis="sp",
+                      text_seq_len=8, image_fmap_size=4), False),
+    ("sp_sparse", "sp", dict(attn_types=("axial_row", "sparse"),
+                             sp_axis="sp", text_seq_len=8,
+                             image_fmap_size=4), False),
+    ("pp", "pp", dict(pp_axis="pp"), False),
+    ("ep", "ep", dict(ff_experts=4, moe_every=1), True),
 )
 
 
@@ -66,7 +76,9 @@ def _flat_paths_and_specs(tree, shardings):
     return paths, expected
 
 
-def _train_shard_entry(kind: str, model_kw: Dict, moe: bool) -> ShardEntry:
+def _train_shard_entry(
+    name: str, kind: str, model_kw: Dict, moe: bool
+) -> ShardEntry:
     """One mesh kind: the full sharded train step, lowered lazily."""
     import jax
     import jax.numpy as jnp
@@ -158,7 +170,7 @@ def _train_shard_entry(kind: str, model_kw: Dict, moe: bool) -> ShardEntry:
         intents.append(rep)
 
     return ShardEntry(
-        name=f"train.{kind}",
+        name=f"train.{name}",
         path=_STEP_PATH,
         symbol="make_train_step",
         mesh_axes={kind: 2},
@@ -173,11 +185,11 @@ def _train_shard_entry(kind: str, model_kw: Dict, moe: bool) -> ShardEntry:
 
 
 def build_train_entries() -> List[ShardEntry]:
-    """The six mesh-kind train entries alone — the multichip dryrun's
+    """The seven mesh-kind train entries alone — the multichip dryrun's
     provenance cross-check audits exactly these (__graft_entry__.py)."""
     return [
-        _train_shard_entry(kind, model_kw, moe)
-        for kind, model_kw, moe in MESH_KINDS
+        _train_shard_entry(name, kind, model_kw, moe)
+        for name, kind, model_kw, moe in MESH_KINDS
     ]
 
 

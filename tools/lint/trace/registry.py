@@ -184,6 +184,24 @@ def build_entry_points() -> List[EntryPoint]:
     copy_pad = pages_for(T, page)
     copy_vec = SDS((copy_pad,), jnp.int32)
 
+    # the donated prefix-map jit (this PR's follow-on closing the PR 10
+    # set): its ids vector pads to the page-table ROW width, and the
+    # shift-ring seam arrives as a keystr-keyed dict of row avals derived
+    # from the cache tree itself — the same dict shape every admission
+    # call builds from a prefix node's ring
+    map_ids = SDS((n_pages_slot,), jnp.int32)
+
+    def ring_avals(cache):
+        rows = {}
+
+        def fn(path, x):
+            if getattr(path[-1], "key", None) == "shift_hist":
+                rows[jax.tree_util.keystr(path)] = SDS(x.shape[1:], x.dtype)
+            return x
+
+        jax.tree_util.tree_map_with_path(fn, cache)
+        return rows
+
     # chunk widths exactly as the engine schedules them: simulate the
     # REAL Engine._next_chunk (1-token tails merged) over (T, chunk)
     shim = SimpleNamespace(config=cfg, T=T)
@@ -537,6 +555,59 @@ def build_entry_points() -> List[EntryPoint]:
             )],
         ),
         EntryPoint(
+            name="serving.prefix_map",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_map_prefix_jit",
+            fn=eng._map_prefix_jit,
+            lower=eng._map_prefix_jit.lower,
+            static_argnums=(),
+            donate={"cache": 0},
+            # the donated prefix-hit publish/map (the last PR 10 follow-on):
+            # page-table row, cache/shift indices, and shift-ring seam land
+            # in ONE fixed-shape dispatch — one signature per cache tree it
+            # mutates: the fused/full-hit map over the batched arena tree,
+            # the split engine's batch-1 seeding (n_ids == 0), and the spec
+            # engine's composition over the ring-widened arena tree
+            signatures=[
+                Signature(
+                    "map_batched",
+                    (cacheB_arena, i32, map_ids, i32, i32,
+                     ring_avals(cacheB_arena)),
+                ),
+                Signature(
+                    "seed_split",
+                    (cache1, i32, map_ids, i32, i32, ring_avals(cache1)),
+                ),
+                Signature(
+                    "map_spec",
+                    (cacheB_spec_arena, i32, map_ids, i32, i32,
+                     ring_avals(cacheB_spec_arena)),
+                ),
+            ],
+        ),
+        EntryPoint(
+            name="serving.prefix_map_quant",
+            path="dalle_pytorch_tpu/serving/engine.py",
+            symbol="_map_prefix_jit",
+            fn=eng._map_prefix_jit,
+            lower=eng._map_prefix_jit.lower,
+            static_argnums=(),
+            donate={"cache": 0},
+            # quantized prefix engine's map/seed — own entry for the same
+            # signature-0 aliasing-audit reason as serving.page_copy_quant
+            signatures=[
+                Signature(
+                    "map_quant",
+                    (cacheB_q_arena, i32, map_ids, i32, i32,
+                     ring_avals(cacheB_q_arena)),
+                ),
+                Signature(
+                    "seed_split_quant",
+                    (cache1_q, i32, map_ids, i32, i32, ring_avals(cache1_q)),
+                ),
+            ],
+        ),
+        EntryPoint(
             name="serving.page_copy_across_quant",
             path="dalle_pytorch_tpu/serving/engine.py",
             symbol="_copy_pages_across_jit",
@@ -553,6 +624,7 @@ def build_entry_points() -> List[EntryPoint]:
             )],
         ),
         _train_entry(dalle, B),
+        _block_sparse_entry(dalle, T),
         EntryPoint(
             name="sampling.generate",
             path="dalle_pytorch_tpu/models/sampling.py",
@@ -567,6 +639,44 @@ def build_entry_points() -> List[EntryPoint]:
         ),
     ]
     return entries
+
+
+def _block_sparse_entry(dalle, T: int) -> EntryPoint:
+    """The pair-grid block-sparse attention kernel
+    (ops/block_sparse_attention.py) over a canonical axial layout at the
+    audit model's internal sequence — the jit the sparse training/prefill
+    paths route through behind DALLE_TPU_SPARSE_KERNEL. Abstract trace
+    only (lower=None): Pallas calls abstract-eval fine, and the audit
+    guards the program shape (signatures, no readbacks), while the
+    numerical contract lives in tests/test_block_sparse.py's interpret
+    parity tier."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.ops import block_sparse_attention as bs
+    from dalle_pytorch_tpu.ops import masks as masks_lib
+
+    SDS = jax.ShapeDtypeStruct
+    n = T + dalle.image_seq_len
+    layout = bs.compile_block_layout(
+        masks_lib.axial_mask(T, dalle.image_fmap_size, axis=0)[:n, :n], 4, 4
+    )
+    fn = jax.jit(
+        lambda q, k, v: bs.block_sparse_attention(
+            q, k, v, layout, interpret=True
+        )
+    )
+    qkv = SDS((1, dalle.heads, n, dalle.dim_head), jnp.float32)
+    return EntryPoint(
+        name="ops.block_sparse",
+        path="dalle_pytorch_tpu/ops/block_sparse_attention.py",
+        symbol="block_sparse_attention",
+        fn=fn,
+        lower=None,
+        static_argnums=(),
+        donate={},
+        signatures=[Signature("axial", (qkv, qkv, qkv))],
+    )
 
 
 def _train_entry(dalle, batch: int) -> EntryPoint:
