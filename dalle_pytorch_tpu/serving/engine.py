@@ -122,7 +122,9 @@ from ..models.sampling import (
 from ..ops import kv_policy, paged_kv
 from ..utils.faults import FAULTS
 from ..utils.metrics import counters, gauges, histograms
-from ..utils.resilience import verify_dir_manifest, write_dir_manifest
+from ..utils.resilience import (
+    retry_after_hint, verify_dir_manifest, write_dir_manifest,
+)
 from ..utils.telemetry import TELEMETRY
 from .prefix_cache import (
     PrefixCache,
@@ -2947,11 +2949,23 @@ class Engine:
             outcome=Outcome.REJECTED.value, reject_reason=reason.value,
         )
         self.histograms.observe("serve.request_latency_s", 0.0)
+        # load-typed rejections carry a backoff hint scaled by current
+        # pressure (fleet-wide when routed, this engine's pool alone when
+        # standalone); DEMAND_EXCEEDS_POOL is permanent — no hint
+        hint = None
+        if reason is RejectReason.QUEUE_FULL:
+            occ = (
+                self._fleet_occupancy()
+                if self._fleet_occupancy is not None
+                else self.pool.occupancy
+            )
+            hint = retry_after_hint(occ)
         result = RequestResult(
             request_id=entry.request_id,
             outcome=Outcome.REJECTED,
             reject_reason=reason,
             total_latency_s=0.0,
+            retry_after_s=hint,
         )
         self.results[entry.request_id] = result
         self._outcome_counts[Outcome.REJECTED] += 1

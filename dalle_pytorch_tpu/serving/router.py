@@ -31,10 +31,14 @@ reset by any successful admission) open the breaker: the replica is
 DEGRADED — no new admissions, in-flight work continues — and readmitted
 (→ HEALTHY) after a ``RetryPolicy`` exponential-backoff delay
 (``breaker_backoff``; attempt i waits ``min(max_delay, base * 2**i)``,
-deterministic — the policy's jitter field is for cross-process
-thundering herds and is deliberately ignored so chaos drills replay
-exactly). Re-trips back off further; ``breaker_backoff.attempts``
-consecutive trips without an intervening success escalate to DEAD.
+full-jittered by the policy's ``jitter`` field through ONE router-owned
+``random.Random(RouterConfig.backoff_seed)`` — deterministic under a
+fixed seed, so chaos drills still replay exactly while a correlated
+outage no longer re-collides every ladder in lockstep; the default
+policies keep ``jitter=0.0``, which reproduces the historical
+jitter-free schedule bit-for-bit). Re-trips back off further;
+``breaker_backoff.attempts`` consecutive trips without an intervening
+success escalate to DEAD.
 The backoff is the admission-livelock guard: a flapping health signal
 (injectable: ``health_flap``) makes the replica *progressively quieter*
 instead of bouncing admissions forever.
@@ -81,6 +85,12 @@ the SIGTERM path: fleet-wide drain, journal seal, prefix snapshot.
 rejects typed ``queue_full`` (with a ``router.shed`` event); demand that
 can never fit a replica rejects ``demand_exceeds_pool``; a fleet with
 no live replica rejects (and flushes its queue as) ``no_replica``.
+Load-typed rejections (``queue_full``/``no_replica``) carry a
+``retry_after_s`` hint — occupancy-scaled for sheds, the earliest
+pending respawn for a dead fleet — observed into the
+``router.retry_after_s`` histogram; well-behaved clients (the traffic
+sim's closed-loop model) honor it instead of hammering a saturated
+fleet on their own schedule.
 Watermark degradation spans the fleet: every engine's clamp policy is
 fed the *aggregate* occupancy over live replicas (``fleet_occupancy``
 hook), so pressure anywhere — including capacity lost to a dead
@@ -100,14 +110,15 @@ in flight, same contract as §9's crash captures.
 
 from __future__ import annotations
 
+import random
 import threading
 from dataclasses import dataclass, field
 from enum import Enum
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..utils.faults import FAULTS
 from ..utils.metrics import counters, gauges, histograms
-from ..utils.resilience import RetryPolicy
+from ..utils.resilience import RetryPolicy, retry_after_hint
 from ..utils.telemetry import TELEMETRY
 from .engine import Engine, EngineConfig
 from .journal import RequestJournal
@@ -153,8 +164,9 @@ class RouterConfig:
     # circuit breaker: consecutive prefill failures before DEGRADED
     breaker_threshold: int = 3
     # readmission schedule; .attempts consecutive trips escalate to DEAD.
-    # retry_on is unused (nothing is raised); jitter is ignored for
-    # deterministic drills — see module docstring.
+    # retry_on is unused (nothing is raised); jitter draws from the
+    # router's seeded backoff RNG (backoff_seed below) — the default 0.0
+    # reproduces the historical deterministic schedule exactly.
     breaker_backoff: RetryPolicy = RetryPolicy(
         attempts=5, base_delay=1.0, max_delay=60.0, jitter=0.0,
         retry_on=(),
@@ -177,6 +189,13 @@ class RouterConfig:
         attempts=3, base_delay=1.0, max_delay=60.0, jitter=0.0,
         retry_on=(),
     )
+    # seeds the ONE router-owned RNG that draws backoff jitter for the
+    # breaker and respawn ladders (full jitter, the ``RetryPolicy.delay``
+    # formula). Fixed seed => bit-reproducible schedules, so chaos drills
+    # and the traffic sim replay exactly; with both policies' jitter at
+    # the 0.0 default the RNG is never consulted and the schedule is the
+    # historical deterministic one.
+    backoff_seed: int = 0
 
 
 @dataclass
@@ -286,7 +305,8 @@ class Router:
     def __init__(self, dalle, params, config: RouterConfig = RouterConfig(),
                  engine_config: EngineConfig = EngineConfig(),
                  clock: Optional[Clock] = None,
-                 journal: Optional[RequestJournal] = None):
+                 journal: Optional[RequestJournal] = None,
+                 engine_factory: Optional[Callable[..., Engine]] = None):
         assert config.n_replicas >= 1, config.n_replicas
         self.config = config
         self._lock = threading.RLock()
@@ -296,6 +316,15 @@ class Router:
         self._dalle = dalle
         self._params = params
         self._engine_config = engine_config
+        # replica construction seam: tools/traffic_sim.py substitutes a
+        # modeled StubEngine fleet under the REAL router policy (health
+        # machine, breaker, respawn, failover, shed). Called with
+        # (rid, clock=, metric_labels=, fleet_occupancy=) at construction
+        # AND at every respawn; None = build the real Engine.
+        self._engine_factory = engine_factory
+        # one RNG for every backoff draw (breaker + respawn ladders);
+        # seeded so the jittered schedule replays bit-identically
+        self._backoff_rng = random.Random(config.backoff_seed)
         # durable request journal (serving/journal.py): admissions and
         # terminal outcomes are logged so a full-process crash replays
         # unfinished requests bit-identically on restart. None = no
@@ -319,6 +348,12 @@ class Router:
         """One replica's engine — used at construction and by every
         respawn, so a resurrected replica is the same build as the
         original (same model, params, config, shared clock, labels)."""
+        if self._engine_factory is not None:
+            return self._engine_factory(
+                rid, clock=self.clock,
+                metric_labels={"replica": str(rid)},
+                fleet_occupancy=self.fleet_occupancy,
+            )
         return Engine(
             self._dalle, self._params, self._engine_config,
             clock=self.clock, metric_labels={"replica": str(rid)},
@@ -747,9 +782,7 @@ class Router:
         if r.breaker_trips > max(1, policy.attempts):
             self._kill_locked(r, "breaker_exhausted")
             return
-        delay = min(
-            policy.max_delay, policy.base_delay * (2 ** (r.breaker_trips - 1))
-        )
+        delay = policy.delay(r.breaker_trips - 1, self._backoff_rng)
         r.retry_at = self.clock.now() + delay
         r.state = ReplicaState.DEGRADED
         counters.inc("router.breaker_opens")
@@ -795,9 +828,12 @@ class Router:
 
     def _schedule_respawn_locked(self, r: _Replica) -> None:
         """DEAD -> RESPAWNING with an exponential-backoff rebuild time —
-        or permanently DEAD once the ladder is exhausted. Deterministic
-        like the breaker (jitter deliberately ignored) so chaos drills
-        replay exactly."""
+        or permanently DEAD once the ladder is exhausted. Jittered like
+        the breaker (the shared seeded RNG): a correlated outage that
+        kills N replicas at once must NOT schedule N rebuilds for the
+        same instant, or the herd re-collides on respawn — with the
+        default ``jitter=0.0`` the schedule is the historical
+        deterministic one."""
         if r.respawns >= self.config.max_respawns:
             r.respawn_at = None
             r.death_reason = f"{r.death_reason} (respawns exhausted)"
@@ -807,9 +843,7 @@ class Router:
             )
             return
         policy = self.config.respawn_backoff
-        delay = min(
-            policy.max_delay, policy.base_delay * (2 ** r.respawns)
-        )
+        delay = policy.delay(r.respawns, self._backoff_rng)
         r.respawns += 1
         r.respawn_at = self.clock.now() + delay
         r.state = ReplicaState.RESPAWNING
@@ -853,15 +887,50 @@ class Router:
     def _flush_no_replica_locked(self) -> None:
         """Fleet fully dead: every queued request ends typed rather than
         hanging — the none-lost half of the accounting invariant."""
+        hint = self._retry_after_locked(RejectReason.NO_REPLICA)
         for entry in list(self._queue):
             self._queue.remove(entry)
             counters.inc("router.no_replica")
+            if hint is not None:
+                histograms.observe("router.retry_after_s", hint)
             self._finish_locked(entry, RequestResult(
                 request_id=entry.request_id, outcome=Outcome.REJECTED,
                 reject_reason=RejectReason.NO_REPLICA,
                 total_latency_s=self.clock.now() - entry.submit_time,
+                retry_after_s=hint,
                 detail="fleet has no live replica",
             ))
+
+    def _retry_after_locked(
+        self, reason: RejectReason,
+    ) -> Optional[float]:
+        """Backoff hint for a load-typed rejection (the
+        ``RequestResult.retry_after_s`` satellite of the traffic sim).
+        QUEUE_FULL scales the breaker ladder's base delay by fleet
+        occupancy (``retry_after_hint``); NO_REPLICA answers with the
+        fleet's ACTUAL comeback time — the earliest pending respawn —
+        falling back to one respawn-ladder rung when nothing is
+        scheduled. DEMAND_EXCEEDS_POOL gets None: the demand can never
+        fit, retrying is futile and hinting otherwise would invite a
+        permanent retry loop."""
+        if reason is RejectReason.QUEUE_FULL:
+            policy = self.config.breaker_backoff
+            return retry_after_hint(
+                self.fleet_occupancy(),
+                base_delay=policy.base_delay, max_delay=policy.max_delay,
+            )
+        if reason is RejectReason.NO_REPLICA:
+            now = self.clock.now()
+            pending = [
+                r.respawn_at - now
+                for r in self._replicas
+                if r.state is ReplicaState.RESPAWNING
+                and r.respawn_at is not None
+            ]
+            if pending:
+                return max(0.0, min(pending))
+            return self.config.respawn_backoff.base_delay
+        return None
 
     # ----------------------------------------------------------- dispatch
 
@@ -933,11 +1002,15 @@ class Router:
     # ----------------------------------------------------------- plumbing
 
     def _reject_locked(self, entry: _RouterEntry, reason: RejectReason) -> RequestResult:
+        hint = self._retry_after_locked(reason)
+        if hint is not None:
+            histograms.observe("router.retry_after_s", hint)
         result = RequestResult(
             request_id=entry.request_id,
             outcome=Outcome.REJECTED,
             reject_reason=reason,
             total_latency_s=0.0,
+            retry_after_s=hint,
         )
         self._finish_locked(entry, result)
         return result

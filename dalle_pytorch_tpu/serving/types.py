@@ -89,6 +89,11 @@ class RequestResult:
     # request that never finished a prefill reports None.
     ttft_s: Optional[float] = None
     total_latency_s: Optional[float] = None
+    # server-provided backoff hint on load-typed rejections (QUEUE_FULL /
+    # NO_REPLICA): how long the submitter should wait before retrying,
+    # derived from fleet occupancy and the respawn ladder. None on every
+    # other outcome — DEMAND_EXCEEDS_POOL is permanent, retrying is futile.
+    retry_after_s: Optional[float] = None
     detail: str = ""
 
     def to_json(self) -> dict:
@@ -105,6 +110,7 @@ class RequestResult:
             "queue_latency_s": self.queue_latency_s,
             "ttft_s": self.ttft_s,
             "total_latency_s": self.total_latency_s,
+            "retry_after_s": self.retry_after_s,
             "detail": self.detail,
         }
 

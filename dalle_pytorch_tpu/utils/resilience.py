@@ -42,6 +42,19 @@ class RetryPolicy:
     jitter: float = 0.5
     retry_on: Tuple[Type[BaseException], ...] = (OSError,)
 
+    def delay(self, attempt: int, rng: Optional[random.Random] = None,
+              ) -> float:
+        """Backoff before retry number ``attempt`` (0-based): the one
+        formula every ladder shares — ``retry()`` below, the router's
+        breaker/respawn ladders, and traffic-sim clients. With ``rng``
+        None the jitter factor is omitted (the deterministic upper
+        envelope); pass a seeded ``random.Random`` to draw full jitter —
+        callers that need replayable schedules own the RNG."""
+        d = min(self.max_delay, self.base_delay * (2 ** attempt))
+        if rng is not None and self.jitter > 0.0:
+            d *= 1.0 - self.jitter * rng.random()
+        return d
+
     def from_env(self, prefix: str) -> "RetryPolicy":
         """Override attempts/base_delay from ``<PREFIX>_RETRIES`` /
         ``<PREFIX>_BACKOFF`` (operators tune retry budgets per deployment
@@ -54,6 +67,20 @@ class RetryPolicy:
         if backoff is not None:
             out = replace(out, base_delay=float(backoff))
         return out
+
+
+def retry_after_hint(occupancy: float, base_delay: float = 0.5,
+                     max_delay: float = 30.0) -> float:
+    """Server-side backoff hint for a load-typed rejection
+    (``RequestResult.retry_after_s``): scale the ladder's base delay by
+    how loaded the fleet is — an idle fleet says "come right back", a
+    saturated one says "wait out ~one ladder rung". Linear in occupancy
+    (hint = base * (1 + 4*occ), clamped to ``max_delay``) so the hint
+    stays proportional to the pressure that caused the reject; clients
+    spread over [0, hint] via their own jitter, the hint is the center
+    of mass, not a synchronization point."""
+    occ = min(1.0, max(0.0, occupancy))
+    return min(max_delay, base_delay * (1.0 + 4.0 * occ))
 
 
 def retry(
@@ -82,8 +109,7 @@ def retry(
                 break
             if on_retry is not None:
                 on_retry(attempt, e)
-            delay = min(policy.max_delay, policy.base_delay * (2 ** attempt))
-            delay *= 1.0 - policy.jitter * rng.random()
+            delay = policy.delay(attempt, rng)
             print(
                 f"retry {attempt + 1}/{attempts} "
                 f"{describe or getattr(fn, '__name__', 'call')}: "

@@ -215,6 +215,10 @@ HISTOGRAMS = frozenset({
     # replica kill -> healthy-again (respawn) MTTR, per replica label —
     # the bench recovery record's source
     "serve.recovery_s",
+    # backoff hints attached to load-typed rejections (queue_full /
+    # no_replica): what the fleet told clients to wait — the traffic
+    # sim's storm-amplification guard reads this distribution
+    "router.retry_after_s",
 })
 
 # span durations are auto-observed as "<span>_s" (utils/telemetry.py);

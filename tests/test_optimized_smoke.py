@@ -75,6 +75,56 @@ def test_decode_parity_with_optimizations_enabled(optimized_xla):
     assert ((toks >= 0) & (toks < dalle.num_image_tokens)).all()
 
 
+def test_serving_decode_parity_with_optimizations_enabled(optimized_xla):
+    """The serving path's pinned contract — chunked prefill bit-identical
+    to monolithic — re-run with the optimization pipeline ENABLED: the
+    continuous-batching engine's prefill/decode programs (the ones
+    bench.py and production serving actually compile) must sample the
+    same tokens either way (ADVICE.md round 5: every other serving test
+    runs unoptimized)."""
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, FakeClock, Outcome, Request,
+    )
+
+    dalle = DALLE(
+        dim=32, depth=2, num_text_tokens=16, text_seq_len=4,
+        num_image_tokens=12, image_fmap_size=2, heads=2, dim_head=8,
+        attn_types=("full",), shift_tokens=True, rotary_emb=True,
+    )
+    rng = np.random.RandomState(0)
+    text = jnp.asarray(rng.randint(1, 16, size=(2, 4)), jnp.int32)
+    image = jnp.asarray(rng.randint(0, 12, size=(2, 4)), jnp.int32)
+    params = dalle.init(jax.random.key(0), text, image)["params"]
+
+    def serve(prefill_chunk):
+        eng = Engine(
+            dalle, params,
+            EngineConfig(max_batch=2, prefill_chunk=prefill_chunk),
+            clock=FakeClock(step_dt=1.0),
+        )
+        for i in range(2):
+            assert eng.submit(Request(
+                request_id=f"o{i}",
+                prompt=rng.__class__(100 + i).randint(
+                    1, 16, size=(4,)).astype(np.int32),
+                max_new_tokens=4, seed=i,
+            )) is None
+        eng.run(max_steps=200)
+        for r in eng.results.values():
+            assert r.outcome is Outcome.COMPLETED, r
+        return {k: np.asarray(r.tokens) for k, r in eng.results.items()}
+
+    mono = serve(prefill_chunk=None)
+    chunked = serve(prefill_chunk=2)
+    assert mono.keys() == chunked.keys()
+    for rid in mono:
+        np.testing.assert_array_equal(
+            mono[rid], chunked[rid],
+            err_msg=f"{rid}: optimized-XLA serving chunked/monolithic "
+                    "divergence",
+        )
+
+
 @pytest.mark.parametrize("attn_type", ["axial_row", "conv_like"])
 def test_attention_parity_with_optimizations_enabled(optimized_xla, attn_type):
     """Grouped FLOP-efficient attention vs the dense-masked oracle under

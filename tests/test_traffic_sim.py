@@ -1,0 +1,236 @@
+"""Fleet traffic-simulator tests (tools/traffic_sim.py; DESIGN §8.4).
+
+The modeled lane's in-run asserts (typed accounting, replay seeding,
+goodput bounds, storm amplification guard) fire inside the tool; these
+tests pin the harness itself: seeded reproducibility of whole lane
+records, retry-storm amplification with desynchronized respawn
+ladders, correlated-outage MTTR accounting, modeled-vs-real fidelity
+cross-validation, and the subprocess release gates the CI tiers run
+(--quick in the fast tier, --sweep behind -m slow).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import traffic_sim as ts  # noqa: E402
+from dalle_pytorch_tpu.utils.faults import FAULTS  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def small_spec(**kw):
+    kw.setdefault("n_replicas", 3)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("queue_limit", 32)
+    return ts.FleetSpec(**kw)
+
+
+def small_workload(**kw):
+    kw.setdefault("n_requests", 400)
+    kw.setdefault("qps", 40.0)
+    kw.setdefault("max_new_lo", 4)
+    kw.setdefault("max_new_hi", 8)
+    return ts.Workload(**kw)
+
+
+def run_small_lane(seed=0, **wkw):
+    spec = small_spec()
+    w = small_workload(seed=seed, **wkw)
+    router = ts.build_modeled_router(
+        spec, ts.IterationCostModel(), seed=seed
+    )
+    return ts.run_lane(
+        router, ts.generate_workload(w), ts.ClientPolicy(seed=seed)
+    )
+
+
+class TestSeededReproducibility:
+    def test_identical_seed_identical_record(self):
+        """Two fresh fleets, same seed: every field of the lane record
+        — outcomes, percentiles, occupancy trace, iteration counts —
+        must be bit-equal (the replay contract every scenario builds
+        on)."""
+        a = run_small_lane(seed=7)
+        FAULTS.reset()
+        b = run_small_lane(seed=7)
+        assert json.dumps(a, sort_keys=True) == json.dumps(
+            b, sort_keys=True
+        )
+
+    def test_different_seed_different_trace(self):
+        a = run_small_lane(seed=1)
+        FAULTS.reset()
+        b = run_small_lane(seed=2)
+        assert json.dumps(a, sort_keys=True) != json.dumps(
+            b, sort_keys=True
+        )
+
+    def test_workload_generators_seeded(self):
+        for arrival in ("poisson", "diurnal", "burst"):
+            w = small_workload(arrival=arrival, seed=3)
+            xs = ts.generate_workload(w)
+            ys = ts.generate_workload(w)
+            assert [l.t_arrival for l in xs] == [l.t_arrival for l in ys]
+            assert [l.base.seed for l in xs] == [l.base.seed for l in ys]
+            # arrivals are sorted and priorities span the spread
+            ts_arr = [l.t_arrival for l in xs]
+            assert ts_arr == sorted(ts_arr)
+            assert {l.base.priority for l in xs} == {0, 1, 2}
+
+
+class TestTypedAccounting:
+    def test_every_logical_request_final_under_overload(self):
+        """3x saturation: heavy shed, every logical request still ends
+        with exactly one typed final outcome and the counts add up."""
+        rec = run_small_lane(seed=0, qps=250.0, n_requests=600)
+        assert sum(rec["outcomes"].values()) == rec["logical_requests"]
+        assert rec["shed_frac"] > 0.0          # overload genuinely shed
+        assert rec["retries"] > 0              # closed loop genuinely retried
+        assert rec["completed"] == rec["outcomes"].get("completed", 0)
+
+    def test_retry_hints_observed(self):
+        """Load-typed rejects carry retry_after_s and the fleet's
+        router.retry_after_s histogram sees them."""
+        from dalle_pytorch_tpu.utils.metrics import histograms
+
+        h0 = histograms.get("router.retry_after_s")
+        n0 = h0.count if h0 is not None else 0
+        rec = run_small_lane(seed=0, qps=250.0, n_requests=600)
+        assert rec["shed_frac"] > 0.0
+        h = histograms.get("router.retry_after_s")
+        assert h is not None and h.count > n0
+
+
+class TestRetryStorm:
+    def _storm(self, seed=0):
+        spec = small_spec()
+        base = small_workload(n_requests=500)
+        return ts.run_storm(
+            spec, base, sat_qps=35.0, cost=ts.IterationCostModel(),
+            seed=seed, kills=spec.n_replicas, respawn_fails=1,
+        )
+
+    def test_amplification_guard_and_desync(self):
+        """run_storm's own asserts are the guard; pin the evidence it
+        returns: lockstep first-rung delays without jitter, distinct
+        with it, and jitter+hints completing at least as much."""
+        storm = self._storm(seed=0)
+        b = storm["baseline"]["ladder_first_rung_s"]
+        g = storm["guarded"]["ladder_first_rung_s"]
+        assert len(set(b)) == 1, b
+        assert len(set(g)) == len(g) > 1, g
+        assert all(d <= b[0] for d in g)   # full jitter only shortens
+        assert (
+            storm["guarded"]["completed"]
+            >= storm["baseline"]["completed"]
+        )
+
+    def test_storm_rejects_are_load_typed(self):
+        # a tiny queue and a long, fail-extended outage: the closed
+        # loop MUST shed — and everything still lands typed
+        spec = small_spec(queue_limit=8, respawn_base_delay=2.0)
+        storm = ts.run_storm(
+            spec, small_workload(n_requests=500), sat_qps=50.0,
+            cost=ts.IterationCostModel(), seed=1,
+            kills=spec.n_replicas, respawn_fails=1,
+        )
+        for tag in ("baseline", "guarded"):
+            out = storm[tag]["outcomes"]
+            assert sum(out.values()) == storm[tag]["logical_requests"]
+        # the unjittered/no-hint baseline exhausts its retry budget
+        # inside the outage and sheds load-typed; guarded clients wait
+        # the hint out and lose no more than it did
+        assert storm["baseline"]["outcomes"].get("rejected", 0) > 0
+        assert (
+            storm["guarded"]["outcomes"].get("rejected", 0)
+            <= storm["baseline"]["outcomes"].get("rejected", 0)
+        )
+
+
+class TestCorrelatedOutageMTTR:
+    def test_respawn_mttr_accounted(self):
+        """A full-fleet correlated kill respawns every replica; the
+        serve.recovery_s histogram deltas give a positive MTTR at
+        least one base respawn delay long."""
+        spec = small_spec(respawn_base_delay=0.5)
+        storm = ts.run_storm(
+            spec, small_workload(n_requests=400), sat_qps=35.0,
+            cost=ts.IterationCostModel(), seed=3,
+            kills=spec.n_replicas, respawn_fails=0,
+        )
+        # both runs kill the full fleet once: one respawn per replica each
+        assert storm["respawns_observed"] == 2 * spec.n_replicas
+        assert storm["mttr_mean_s"] is not None
+        assert storm["mttr_mean_s"] >= 0.5 * spec.respawn_base_delay
+        for tag in ("baseline", "guarded"):
+            states = storm[tag]["replica_states"]
+            assert all(s == "healthy" for s in states.values()), states
+
+
+@pytest.mark.slow
+class TestFidelity:
+    def test_modeled_matches_real_tiny_fleet(self):
+        """The cross-validation contract: a matched StubEngine fleet
+        predicts the real tiny-model fleet's shed fraction, p99 TTFT
+        and mean occupancy within FIDELITY_TOL (run_fidelity asserts
+        in-run; we additionally pin completion-count agreement)."""
+        rec = ts.run_fidelity(n_requests=200, seed=0)
+        for key, tol in ts.FIDELITY_TOL.items():
+            if key in rec["diffs"]:
+                assert rec["diffs"][key] <= tol, (key, rec["diffs"])
+        assert rec["real"]["completed"] > 0
+        assert (
+            abs(rec["modeled"]["completed"] - rec["real"]["completed"])
+            <= 0.05 * rec["real"]["completed"] + 2
+        )
+
+
+# ----------------------------------------------------- release gates
+
+
+def test_traffic_sim_quick_subprocess_gate():
+    """The fast-tier gate: --quick must push >=100k modeled requests
+    through a >=4-replica fleet inside its wall budget with every
+    in-run assert (accounting, replay, frontier bounds, storm guard)
+    green, and print a well-formed frontier record."""
+    out = subprocess.run(
+        [sys.executable, "tools/traffic_sim.py", "--quick", "--seed", "0"],
+        capture_output=True, text=True, timeout=300, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    rec = json.loads(out.stdout)
+    assert rec["totals"]["modeled_requests"] >= 100_000
+    assert rec["fleet"]["n_replicas"] >= 4
+    assert rec["totals"]["wall_s"] < 60.0
+    assert rec["frontier"]["sustainable_qps"] is not None
+    assert rec["storm"]["mttr_mean_s"] is not None
+    for l in rec["frontier"]["levels"]:
+        assert sum(l["outcomes"].values()) == l["logical_requests"]
+
+
+@pytest.mark.slow
+def test_traffic_sim_sweep_subprocess_gate():
+    """The slow-tier grid: every arrival shape, prefix templates on."""
+    out = subprocess.run(
+        [sys.executable, "tools/traffic_sim.py", "--sweep", "--seed", "0"],
+        capture_output=True, text=True, timeout=600, cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    rec = json.loads(out.stdout)
+    assert set(rec["arrival_grid"]) == {"diurnal", "burst"}
+    hit = max(
+        l["prefix_hit_frac"] for l in rec["frontier"]["levels"]
+    )
+    assert hit > 0.0        # template reuse engaged the prefix model

@@ -18,6 +18,16 @@ the harness resubmits it as the client retry the contract prescribes).
 Training-side sites (``download``, ``shard_open``, ...) have no take
 site in the serving loop and are deliberately not scheduled.
 
+The client half of the loop is closed too (the traffic-sim storm model,
+docs/DESIGN.md §8.4): load-typed rejects (``queue_full`` /
+``no_replica``) are NOT terminal to the soak client — it honors the
+fleet's ``retry_after_s`` hint (seeded jitter on top) and resubmits
+under a fresh attempt id, up to a bounded attempt budget, so the soak
+exercises client-driven retry pressure and not just server-side faults.
+Mid-run a correlated **outage storm** arms ``replica_crash`` for every
+replica at once (``--storm-at``, auto-placed at the midpoint), which is
+exactly the schedule whose retry amplification the hints exist to damp.
+
 The gate, checked every iteration and at the end:
 
 * ``Router.verify_invariants`` clean EVERY iteration — accounting can
@@ -70,12 +80,14 @@ RESTART_SITES = ("journal_torn", "snapshot_corrupt")
 
 
 def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
-             fault_p: float, restart_every: int, snap_every: int) -> dict:
+             fault_p: float, restart_every: int, snap_every: int,
+             storm_at: int = -1) -> dict:
     import numpy as np
+    from dataclasses import replace
 
     from dalle_pytorch_tpu.serving import (
-        Engine, EngineConfig, FakeClock, Outcome, Request, RequestJournal,
-        Router, RouterConfig, replay_unfinished,
+        Engine, EngineConfig, FakeClock, Outcome, RejectReason, Request,
+        RequestJournal, Router, RouterConfig, replay_unfinished,
     )
     from dalle_pytorch_tpu.utils.faults import FAULTS
     from serve_smoke import build_tiny_model
@@ -115,7 +127,12 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
     )
     router_cfg = RouterConfig(
         n_replicas=n_replicas, respawn=True,
-        stall_timeout_s=5.0, queue_limit=4 * n_req,
+        stall_timeout_s=5.0,
+        # small enough that the outage-storm backlog overflows into
+        # load-typed QUEUE_FULL rejects (with retry_after_s hints) the
+        # closed-loop client must ride out — a roomy queue would absorb
+        # the whole storm and never exercise client retry pressure
+        queue_limit=max(2, n_req // 4),
     )
     clock = FakeClock(step_dt=0.25)
 
@@ -127,23 +144,79 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
 
     FAULTS.reset()
     router = build_router()
-    delivered: dict = {}        # rid -> RequestResult, the "client" view
+    if storm_at < 0:
+        storm_at = iters // 2 if iters >= 20 else 0
+    by_rid = {r.request_id: r for r in requests}
+    delivered: dict = {}        # logical rid -> RequestResult (client view)
     submitted: set = set()
     armed_total: dict = {}
+    # logical rid -> {"attempt", "due", "rid"}: a load-typed reject the
+    # closed-loop client will resubmit ("due" is the virtual resubmit
+    # time; None once the attempt is in flight under attempt id "rid")
+    retry_state: dict = {}
+    client_retries = 0
+    hints_honored = 0
+    storm_fired_at = None
     restarts = 0
     snapshots = 0
     torn_total = 0
     next_req = 0
 
-    def poll_results():
-        """Deliver new terminal results to the 'client'; a re-delivered
-        COMPLETED result (outcome record lost to a crash) must match the
-        original bitwise — replay idempotency."""
-        for rid, res in router.results.items():
-            if not rid.startswith("soak"):
+    def logical(rid: str) -> str:
+        return rid.split(".r", 1)[0]
+
+    def classify(lg: str, res) -> None:
+        """Closed-loop client: a load-typed reject with attempt budget
+        left re-enters the arrival stream after the fleet's
+        retry_after_s hint (seeded client jitter on top); anything else
+        is the logical request's terminal outcome."""
+        nonlocal client_retries, hints_honored
+        st = retry_state.get(lg, {"attempt": 0})
+        retriable = (
+            res.outcome is Outcome.REJECTED
+            and res.reject_reason in (
+                RejectReason.QUEUE_FULL, RejectReason.NO_REPLICA,
+            )
+        )
+        if retriable and st["attempt"] < 4:
+            hint = res.retry_after_s
+            if hint is not None:
+                hints_honored += 1
+            delay = min(
+                4.0, hint if hint is not None else 0.25 * 2 ** st["attempt"]
+            ) * (1.0 + 0.25 * rng.random())
+            retry_state[lg] = {
+                "attempt": st["attempt"] + 1,
+                "due": clock.now() + delay, "rid": None,
+            }
+            client_retries += 1
+        else:
+            retry_state.pop(lg, None)
+            delivered[lg] = res
+
+    def fire_retries():
+        """Resubmit every due client retry under a fresh attempt id."""
+        now = clock.now()
+        for lg, st in list(retry_state.items()):
+            if st["due"] is None or st["due"] > now:
                 continue
-            if rid in delivered:
-                prev = delivered[rid]
+            arid = f"{lg}.r{st['attempt']}"
+            st["rid"], st["due"] = arid, None
+            res = router.submit(replace(by_rid[lg], request_id=arid))
+            if res is not None:
+                classify(lg, res)
+
+    def poll_results():
+        """Deliver new terminal results to the 'client' (attempt ids
+        collapse onto their logical request); a re-delivered COMPLETED
+        result (outcome record lost to a crash) must match the original
+        bitwise — replay idempotency."""
+        for rid, res in list(router.results.items()):
+            lg = logical(rid)
+            if not lg.startswith("soak"):
+                continue
+            if lg in delivered:
+                prev = delivered[lg]
                 if (
                     res.outcome is Outcome.COMPLETED
                     and prev.outcome is Outcome.COMPLETED
@@ -152,7 +225,18 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
                         np.asarray(res.tokens), np.asarray(prev.tokens)
                     ), f"{rid}: re-delivered tokens diverge from original"
                 continue
-            delivered[rid] = res
+            if res.outcome is Outcome.COMPLETED:
+                retry_state.pop(lg, None)
+                delivered[lg] = res
+                continue
+            st = retry_state.get(lg)
+            if st is not None:
+                # only the latest attempt's terminal result speaks for
+                # the logical request; older records are stale
+                if st["due"] is None and rid == st["rid"]:
+                    classify(lg, res)
+                continue
+            classify(lg, res)
 
     def restart():
         """Process death: abandon the router mid-flight, rebuild, load
@@ -188,20 +272,48 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
             rid = req.request_id
             if rid in delivered or rid in replayed:
                 continue
+            if rid in retry_state:
+                continue  # the closed-loop client owns this one
             if rid in router.results:
                 continue
             if router.submit(req) is not None:
                 pass  # typed immediate reject lands in results
 
     for it in range(iters):
-        # staggered arrivals: ~one submission every other iteration
-        if next_req < n_req and rng.random() < 0.6:
+        # staggered arrivals spread across ~80% of the run, with half
+        # the workload held back as a storm cohort: while the outage is
+        # fresh, demand bursts at several submissions per iteration
+        # against a dead fleet and a bounded queue — the retry-storm
+        # shape the retry_after_s hints exist to damp (every load-typed
+        # reject re-enters through the closed-loop client above)
+        storm_window = storm_fired_at is not None and it - storm_fired_at <= 8
+        if storm_window:
+            burst = min(3, n_req - next_req)
+        else:
+            cap = n_req - (n_req // 2 if storm_at and it < storm_at else 0)
+            arrival_p = min(0.9, n_req / max(1.0, 0.8 * iters))
+            burst = 1 if next_req < cap and rng.random() < arrival_p else 0
+        for _ in range(burst):
             req = requests[next_req]
             submitted.add(req.request_id)
             next_req += 1
             rejected = router.submit(req)
             if rejected is not None:
-                delivered[req.request_id] = rejected
+                classify(req.request_id, rejected)
+        if storm_at and it == storm_at:
+            # correlated outage storm: every replica dies at once and
+            # the first respawn attempt fails (extending the outage a
+            # backoff rung); the NO_REPLICA rejects it sheds are what
+            # the client retry pressure rides
+            FAULTS.arm("replica_crash", n_replicas)
+            FAULTS.arm("replica_respawn_fail", 1)
+            armed_total["replica_crash"] = (
+                armed_total.get("replica_crash", 0) + n_replicas
+            )
+            armed_total["replica_respawn_fail"] = (
+                armed_total.get("replica_respawn_fail", 0) + 1
+            )
+            storm_fired_at = it
         if rng.random() < fault_p:
             site = SCHEDULED_SITES[rng.randint(len(SCHEDULED_SITES))]
             FAULTS.arm(site, 1)
@@ -221,6 +333,7 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
         router.step()
         router.verify_invariants()
         poll_results()
+        fire_retries()
 
     # quiesce: no new faults, drive everything to a terminal outcome
     # (leftover armed faults would keep killing a fleet trying to finish)
@@ -232,16 +345,33 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
         missing = submitted - set(delivered)
         if not missing:
             break
+        live_ids = {r.request_id for r in router.live_requests()}
+        # a retry attempt lost to a crash (admission torn before the
+        # journal saw it) never produces a record in this incarnation:
+        # re-arm it so fire_retries resubmits under the same attempt id
+        for lg, st in retry_state.items():
+            if (
+                st["due"] is None
+                and st["rid"] is not None
+                and st["rid"] not in router.results
+                and st["rid"] not in live_ids
+            ):
+                st["due"] = clock.now()
+                st["rid"] = None
+        fire_retries()
         # client retry for anything lost without a typed record visible
         # to this incarnation (torn admissions after a crash)
         for req in requests[:next_req]:
             rid = req.request_id
             if (
                 rid in missing
+                and rid in retry_state
+            ):
+                continue  # the closed-loop client owns this one
+            if (
+                rid in missing
                 and rid not in router.results
-                and rid not in set(
-                    r.request_id for r in router.live_requests()
-                )
+                and rid not in live_ids
             ):
                 router.submit(req)
         router.step()
@@ -275,6 +405,9 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
         "mismatched": mismatches,
         "faults_armed": armed_total,
         "faults_fired": fired,
+        "client_retries": client_retries,
+        "retry_hints_honored": hints_honored,
+        "storm_at": storm_fired_at,
         "restarts": restarts,
         "snapshots_saved": snapshots,
         "journal_torn_dropped": torn_total,
@@ -295,6 +428,9 @@ def main(argv=None) -> int:
                     help="process-crash-and-restart period (0 = never)")
     ap.add_argument("--snap-every", type=int, default=15,
                     help="prefix snapshot period (0 = never)")
+    ap.add_argument("--storm-at", type=int, default=-1,
+                    help="iteration of the correlated full-fleet outage "
+                         "storm (-1 = midpoint, 0 = never)")
     args = ap.parse_args(argv)
 
     # static-analysis pre-flight (docs/DESIGN.md §11), the same three
@@ -311,6 +447,7 @@ def main(argv=None) -> int:
         iters=args.iters, seed=args.seed, n_replicas=args.replicas,
         n_req=args.requests, fault_p=args.fault_p,
         restart_every=args.restart_every, snap_every=args.snap_every,
+        storm_at=args.storm_at,
     )
     print(json.dumps(summary, indent=1, sort_keys=True))
     if not summary["ok"]:
