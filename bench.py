@@ -93,6 +93,7 @@ def serving_jit_signatures() -> dict:
     exactly one signature per engine config (DTL11x)."""
     from dalle_pytorch_tpu.models import sampling as _sampling
     from dalle_pytorch_tpu.serving import engine as _engine
+    from dalle_pytorch_tpu.serving import postdecode as _postdecode
 
     fns = {
         "prefill": _engine._prefill_jit,
@@ -105,6 +106,8 @@ def serving_jit_signatures() -> dict:
         "page_copy": _engine._copy_pages_jit,
         "page_copy_across": _engine._copy_pages_across_jit,
         "decode_tokens": _sampling.decode_tokens,
+        "stage_vae_decode": _postdecode._vae_decode_jit,
+        "stage_clip_rerank": _postdecode._clip_rerank_jit,
     }
     out = {}
     for name, fn in fns.items():
@@ -1072,6 +1075,218 @@ def bench_serve_interference(on_cpu: bool, int8: bool | None = None,
         "prompt_positions": T,
         "steady_max_new_tokens": steady_new,
         "arrival_seed": seed,
+        "device": jax.devices()[0].device_kind,
+    }
+
+
+def bench_serve_stages(on_cpu: bool, seed: int = 0):
+    """--serve companion: the post-decode pipeline record (docs/DESIGN.md
+    §8.5). One arrival trace through a chunked engine with the
+    VAE_DECODE -> CLIP_RERANK stages enabled (the canonical
+    contract-shape stage models from the trace registry; both stage jits
+    warmed via ``PostDecodePipeline.warmup()``): a 2x-overload burst up
+    front — every completion past the stage watermark must shed its
+    post-decode work as a TYPED degraded outcome, never queue
+    unboundedly — then a drained tail that measures the steady
+    request->image end-to-end distribution.
+
+    Record: request->image p50/p95/p99 (<-
+    ``serve.stage.request_to_image_s``), per-stage latency
+    (``vae_p50_ms``/``rerank_p50_ms`` <- the auto
+    ``serve.stage.vae_decode_s``/``serve.stage.clip_rerank_s`` span
+    histograms) and ``degraded_frac`` over the overload burst.
+
+    In-bench asserts: 100% typed outcomes; the overload produced
+    typed-degraded completions; the max decode-iteration gap with both
+    stage jits in the dispatch mix stays within the chunked interference
+    bound (one decode dispatch + granted prefill chunks + at most ONE
+    batched dispatch per stage per iteration — stage work is budgeted,
+    so a stage backlog can never stall the token loop for its whole
+    depth); zero backend compiles and zero serving-jit recompiles
+    inside the trace."""
+    tools_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    from serve_smoke import build_tiny_model, build_tiny_stages
+
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, Outcome, Request, check_accounting,
+    )
+    from dalle_pytorch_tpu.serving.postdecode import StageConfig
+    from dalle_pytorch_tpu.utils.metrics import counters, histograms
+    from dalle_pytorch_tpu.utils.telemetry import TELEMETRY
+
+    dalle, params = build_tiny_model()
+    tokens_per = dalle.image_seq_len
+    text_len = dalle.text_seq_len
+    rng = np.random.RandomState(seed)
+    n_over = 8
+    n_tail = 4 if on_cpu else 8
+    prompts = rng.randint(
+        1, 16, size=(n_over + n_tail, text_len)
+    ).astype(np.int32)
+
+    # watermark 0.05: any OTHER request still holding kv pages when a
+    # completion reaches the stage boundary reads as past-saturation ->
+    # typed degrade. The burst therefore degrades (slots stay occupied
+    # the whole drain) while the spaced tail (own pages released before
+    # enqueue, fleet otherwise idle) runs the full pipeline.
+    stages = build_tiny_stages(config=StageConfig(high_watermark=0.05))
+    cfg = EngineConfig(max_batch=2, prefill_chunk=2)
+
+    def run_trace():
+        TELEMETRY.configure(enabled=True, ring_size=1 << 14)
+        engine = Engine(dalle, params, cfg, stages=stages)
+        sig0, bc0 = serving_jit_signatures(), backend_compiles()
+        # warm: both stage jits at the contract batch width, plus token
+        # requests at BOTH slot occupancies — the per-occupancy eager
+        # ops (slot insert, batched sampling state) compile here, not in
+        # the timed trace
+        engine.postdecode.warmup()
+        engine.submit(Request(
+            request_id="__warm__", prompt=np.zeros(text_len, np.int32),
+            max_new_tokens=tokens_per, seed=0,
+        ))
+        engine.run(max_steps=50_000)
+        for i in (1, 2):
+            engine.submit(Request(
+                request_id=f"__warm{i}__",
+                prompt=np.zeros(text_len, np.int32),
+                max_new_tokens=tokens_per, seed=i,
+            ))
+        engine.run(max_steps=50_000)
+        sig1, bc1 = serving_jit_signatures(), backend_compiles()
+        histograms.reset()  # percentiles cover the timed trace only
+
+        gaps: list = []
+        last_decode = [None]
+
+        def drive():
+            while True:
+                d0 = counters.get("serve.decode_steps")
+                busy = engine.step()
+                if counters.get("serve.decode_steps") > d0:
+                    t = time.perf_counter()
+                    if last_decode[0] is not None:
+                        gaps.append(t - last_decode[0])
+                    last_decode[0] = t
+                if not busy:
+                    return
+
+        # 2x-overload burst against the 2-slot engine
+        for i in range(n_over):
+            engine.submit(Request(
+                request_id=f"ov{i}", prompt=prompts[i],
+                max_new_tokens=tokens_per, seed=seed * 7919 + i,
+            ))
+        drive()
+        # drained tail: steady-state request->image samples
+        for i in range(n_tail):
+            engine.submit(Request(
+                request_id=f"tail{i}", prompt=prompts[n_over + i],
+                max_new_tokens=tokens_per, seed=seed * 31 + i,
+            ))
+            drive()
+        check_accounting(engine)
+        sig2, bc2 = serving_jit_signatures(), backend_compiles()
+        TELEMETRY.configure(enabled=False)
+        results = {
+            rid: r for rid, r in engine.results.items()
+            if not rid.startswith("__warm")
+        }
+        return results, gaps, {
+            "compiles_warm": bc1 - bc0 if bc0 >= 0 else -1,
+            "compiles_trace": bc2 - bc1 if bc1 >= 0 else -1,
+            "jit_signatures_warm": _sig_delta(sig1, sig0),
+            "jit_recompiles_trace": _sig_delta(sig2, sig1),
+        }
+
+    def hmax(name: str) -> float:
+        h = histograms.get(name)
+        return 0.0 if h is None or h.count == 0 else h.max
+
+    # a max-gap is a wall-clock order statistic (see
+    # bench_serve_interference): re-measure on a violated margin instead
+    # of failing the bench on one OS scheduling stall
+    for attempt in range(3):
+        results, gaps, compiles = run_trace()
+        bound = 2.0 * (
+            hmax("serve.decode_step_s")
+            + 2.0 * hmax("serve.prefill_chunk_s")
+            + hmax("serve.stage.vae_decode_s")
+            + hmax("serve.stage.clip_rerank_s")
+        ) + 0.01
+        max_gap = max(gaps) if gaps else 0.0
+        if max_gap <= bound:
+            break
+    assert max_gap <= bound, (
+        f"stage dispatches stalled the decode loop past the chunked "
+        f"interference bound: max gap {max_gap * 1e3:.1f} ms > "
+        f"bound {bound * 1e3:.1f} ms (3 attempts)"
+    )
+
+    assert len(results) == n_over + n_tail
+    untyped = {
+        rid: r.outcome for rid, r in results.items()
+        if r.outcome not in (Outcome.COMPLETED,
+                             Outcome.COMPLETED_TOKENS_ONLY,
+                             Outcome.COMPLETED_UNRANKED)
+    }
+    assert not untyped, f"untyped stage outcomes: {untyped}"
+    over = [results[f"ov{i}"] for i in range(n_over)]
+    degraded = [
+        r for r in over if r.outcome is not Outcome.COMPLETED
+    ]
+    assert degraded, (
+        "2x overload never tripped the stage degradation policy"
+    )
+    for r in degraded:
+        assert r.outcome is Outcome.COMPLETED_TOKENS_ONLY, r.outcome
+        assert r.tokens is not None and r.image is None, r.request_id
+    completed = [
+        r for r in results.values() if r.outcome is Outcome.COMPLETED
+    ]
+    assert len(completed) >= n_tail, (
+        f"drained tail did not complete the full pipeline: "
+        f"{len(completed)} < {n_tail}"
+    )
+    for r in completed:
+        assert r.image is not None and r.rerank_score is not None, (
+            r.request_id
+        )
+    assert compiles["compiles_trace"] in (0, -1), (
+        f"stage timed trace compiled {compiles['compiles_trace']} modules"
+    )
+    assert all(
+        v in (0, -1) for v in compiles["jit_recompiles_trace"].values()
+    ), (
+        f"stage timed trace recompiled serving jits: "
+        f"{compiles['jit_recompiles_trace']}"
+    )
+
+    def pct(name: str, q: float) -> float:
+        h = histograms.get(name)
+        return 0.0 if h is None or h.count == 0 else round(
+            h.percentile(q) * 1e3, 2
+        )
+
+    return {
+        "metric": "serve_stage_request_to_image_p99_ms_batch2",
+        "value": pct("serve.stage.request_to_image_s", 99),
+        "unit": "ms",
+        "vs_baseline": None,
+        "p50_ms": pct("serve.stage.request_to_image_s", 50),
+        "p95_ms": pct("serve.stage.request_to_image_s", 95),
+        "p99_ms": pct("serve.stage.request_to_image_s", 99),
+        "vae_p50_ms": pct("serve.stage.vae_decode_s", 50),
+        "rerank_p50_ms": pct("serve.stage.clip_rerank_s", 50),
+        "degraded_frac": round(len(degraded) / n_over, 4),
+        "overload_requests": n_over,
+        "tail_requests": n_tail,
+        "max_decode_gap_ms": round(max_gap * 1e3, 2),
+        "decode_gap_bound_ms": round(bound * 1e3, 2),
+        **compiles,
         "device": jax.devices()[0].device_kind,
     }
 
@@ -2707,6 +2922,7 @@ def main():
             print(json.dumps(_retry(lambda: bench_serve_quant(on_cpu))))
             print(json.dumps(_retry(lambda: bench_serve_fused(on_cpu))))
             print(json.dumps(_retry(lambda: bench_serve_interference(on_cpu))))
+            print(json.dumps(_retry(lambda: bench_serve_stages(on_cpu))))
             print(json.dumps(_retry(lambda: bench_serve_prefix(on_cpu))))
             print(json.dumps(_retry(lambda: bench_serve_spec(on_cpu))))
             print(json.dumps(_retry(lambda: bench_serve_recovery(on_cpu))))

@@ -11,6 +11,7 @@ from .journal import (
     request_from_record,
     request_to_record,
 )
+from .postdecode import PostDecodePipeline, StageConfig, StageSpec
 from .router import ReplicaState, Router, RouterConfig
 from .scheduler import PagePool, Scheduler, TokenBudget, pages_for
 from .types import (
@@ -32,6 +33,7 @@ __all__ = [
     "JournalCorrupt",
     "Outcome",
     "PagePool",
+    "PostDecodePipeline",
     "RejectReason",
     "ReplicaState",
     "Request",
@@ -40,6 +42,8 @@ __all__ = [
     "Router",
     "RouterConfig",
     "Scheduler",
+    "StageConfig",
+    "StageSpec",
     "TokenBudget",
     "check_accounting",
     "pages_for",

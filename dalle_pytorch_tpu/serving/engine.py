@@ -126,6 +126,7 @@ from ..utils.resilience import (
     retry_after_hint, verify_dir_manifest, write_dir_manifest,
 )
 from ..utils.telemetry import TELEMETRY
+from .postdecode import PostDecodePipeline, StageSpec
 from .prefix_cache import (
     PrefixCache,
     chain_blocks,
@@ -792,7 +793,8 @@ class Engine:
     def __init__(self, dalle: DALLE, params, config: EngineConfig = EngineConfig(),
                  clock: Optional[Clock] = None,
                  metric_labels: Optional[dict] = None,
-                 fleet_occupancy=None):
+                 fleet_occupancy=None,
+                 stages: Optional[StageSpec] = None):
         attn_types = tuple(dalle.attn_types or ("full",))
         if "mlp" in attn_types:
             raise EngineUnsupportedModel(
@@ -1013,6 +1015,27 @@ class Engine:
         self._total_pool_pages = (
             (config.max_batch + self._arena_rows) * self.n_pages_slot
         )
+        # post-decode pipeline (serving/postdecode.py, DESIGN.md §8.5):
+        # tokens-complete requests transition VAE_DECODE -> [CLIP_RERANK]
+        # -> DONE under their own per-iteration stage budget; staged
+        # requests stay LIVE (no result yet) but hold no slot or pages.
+        # The pipeline degrades against the same fleet-or-pool occupancy
+        # signal the token watermark uses.
+        self.postdecode: Optional[PostDecodePipeline] = None
+        if stages is not None:
+            self.postdecode = PostDecodePipeline(
+                stages,
+                clock=self.clock,
+                counters=self.counters,
+                gauges=self.gauges,
+                histograms=self.histograms,
+                finish=self._finish_staged,
+                occupancy=lambda: (
+                    self._fleet_occupancy()
+                    if self._fleet_occupancy is not None
+                    else self.pool.occupancy
+                ),
+            )
         self._publish_kv_gauges()
 
     def _kv_format_tag(self) -> bytes:
@@ -1070,6 +1093,47 @@ class Engine:
         self._live.add(request.request_id)
         return None
 
+    def submit_staged(self, request: Request, tokens,
+                      image=None) -> Optional[RequestResult]:
+        """Admit a request DIRECTLY into the post-decode pipeline with
+        its token work already done — the crash-replay / failover resume
+        path (serving/journal.py:replay_unfinished): ``tokens`` are the
+        journaled completed image tokens, ``image`` (if present) the
+        journaled VAE output, so the request resumes at VAE_DECODE or
+        CLIP_RERANK instead of re-decoding. Same typed contract as
+        ``submit``: None on acceptance, the result lands in
+        ``self.results`` at a terminal outcome (possibly immediately, if
+        pipeline pressure degrades it at the door)."""
+        if self.postdecode is None:
+            raise ValueError("engine built without stages=StageSpec(...)")
+        if request.request_id in self.results or request.request_id in self._live:
+            raise ValueError(f"duplicate request_id {request.request_id!r}")
+        self._submitted += 1
+        self.counters.inc("serve.submitted")
+        now = self.clock.now()
+        entry = Entry(request=request, submit_time=now, seq=self._seq)
+        self._seq += 1
+        entry.generated = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        self._req_spans[request.request_id] = TELEMETRY.begin(
+            "serve.request",
+            request_id=request.request_id,
+            priority=request.priority,
+            max_new_tokens=request.max_new_tokens,
+        )
+        self._live.add(request.request_id)
+        # resume paths never re-announce: their stage records are durable
+        self.postdecode.enqueue(
+            entry, np.asarray(tokens, np.int32), image=image, announce=False
+        )
+        return None
+
+    def can_admit_staged(self, request: Request) -> bool:
+        """Whether a staged (tokens-complete) request can be dispatched
+        here — the router's failover gate. Pipeline pressure is handled
+        by typed degradation at enqueue, so the only requirement is that
+        this engine runs the stages at all."""
+        return self.postdecode is not None
+
     def cancel(self, request_id: str) -> None:
         """Request cancellation; takes effect at the next scheduling
         iteration (queued requests terminate without ever prefilling;
@@ -1092,11 +1156,17 @@ class Engine:
         else:
             worked = self._decode_once()
             worked = self._advance_prefills() or worked
+        if self.postdecode is not None:
+            # post-decode stage work runs AFTER the token work of the
+            # iteration, metered by its own budget — subordinate to
+            # decode by construction (DESIGN.md §8.5)
+            worked = self.postdecode.step() or worked
         if worked:
             self.iterations += 1
         self.clock.tick()
         self._publish_gauges()
-        return worked or bool(self.sched) or any(self.slots)
+        return (worked or bool(self.sched) or any(self.slots)
+                or bool(self.postdecode))
 
     def run(self, max_steps: Optional[int] = None) -> Dict[str, RequestResult]:
         """Drive until idle. ``max_steps`` is a test/ops safety valve: the
@@ -1125,6 +1195,7 @@ class Engine:
                 bool(s) and s.phase == _PREFILL for s in self.slots
             ),
             "queued": len(self.sched),
+            "staged": 0 if self.postdecode is None else len(self.postdecode),
             "pool_total": self.pool.total,
             "pool_used": self.pool.used,
             "pool_occupancy": self.pool.occupancy,
@@ -1158,6 +1229,12 @@ class Engine:
                     slot.entry, Outcome.CANCELLED,
                     tokens=self._partial_tokens(slot),
                 )
+        # ... then staged (post-decode pipeline): cancel and deadline in
+        # one sweep — the typed outcome carries the partial results
+        # (tokens always, the image if VAE had finished)
+        if self.postdecode is not None:
+            for rid in self.postdecode.sweep(self._cancel_requested, now):
+                self._cancel_requested.discard(rid)
         # cancels naming unknown or already-finished requests (a normal
         # client race) must not accumulate forever in a long-lived engine
         self._cancel_requested &= self._live
@@ -1863,7 +1940,11 @@ class Engine:
                 (s for s in self.slots if s), key=lambda s: s.admit_seq
             )
         ]
-        return queued + running
+        staged = (
+            [] if self.postdecode is None
+            else [s.entry.request for s in self.postdecode._staged]
+        )
+        return queued + running + staged
 
     def _maybe_snapshot(self, slot: _Slot, cache, row: int) -> None:
         """Capture the shift-ring seam when a prefill lands exactly on a
@@ -2935,11 +3016,33 @@ class Engine:
             # pages, which the release reset zeroes
             self._publish(slot)
         self._release_slot(slot)
+        if self.postdecode is not None:
+            # tokens complete but the REQUEST is not: it transitions into
+            # the post-decode pipeline (slot and pages already released —
+            # staged work holds no kv), staying live until a stage
+            # outcome lands. serve.completed moves with it: counted at
+            # the pipeline's COMPLETED, so the counter keeps meaning
+            # "requests fully served".
+            self.postdecode.enqueue(
+                slot.entry, np.asarray(slot.entry.generated, np.int32)
+            )
+            return
         self.counters.inc("serve.completed")
         self._finish(
             slot.entry, Outcome.COMPLETED,
             tokens=np.asarray(slot.entry.generated, np.int32),
         )
+
+    def _finish_staged(self, entry: Entry, outcome: Outcome,
+                       tokens: Optional[np.ndarray],
+                       image=None, score=None, detail: str = "") -> None:
+        """Terminal sink for the post-decode pipeline — every staged
+        request ends here with its typed outcome and whatever results
+        its completed stages produced."""
+        if outcome is Outcome.COMPLETED:
+            self.counters.inc("serve.completed")
+        self._finish(entry, outcome, tokens, detail=detail,
+                     image=image, rerank_score=score)
 
     def _reject(self, entry: Entry, reason: RejectReason) -> RequestResult:
         self.counters.inc("serve.rejected")
@@ -2972,7 +3075,8 @@ class Engine:
         return result
 
     def _finish(self, entry: Entry, outcome: Outcome,
-                tokens: Optional[np.ndarray], detail: str = "") -> None:
+                tokens: Optional[np.ndarray], detail: str = "",
+                image=None, rerank_score=None) -> None:
         now = self.clock.now()
         self._live.discard(entry.request_id)
         if outcome is not Outcome.COMPLETED:
@@ -3007,6 +3111,8 @@ class Engine:
             ),
             ttft_s=entry.ttft_s,
             total_latency_s=now - entry.submit_time,
+            image=image,
+            rerank_score=rerank_score,
             detail=detail,
         )
 
@@ -3041,15 +3147,22 @@ class Engine:
         scheduling iteration."""
         running_ids = {s.entry.request_id for s in self.slots if s}
         queued_ids = self.sched.ids()
+        staged_ids = (
+            set() if self.postdecode is None else set(self.postdecode.ids())
+        )
         both = [rid for rid in self._live if rid in self.results]
         assert not both, f"request both live and finished: {sorted(both)}"
         assert len(self.results) + len(self._live) == self._submitted, (
             f"{self._submitted} submitted but {len(self.results)} results "
             f"+ {len(self._live)} live"
         )
-        assert self._live == queued_ids | running_ids, (
+        assert self._live == queued_ids | running_ids | staged_ids, (
             f"live set {sorted(self._live)} != queued {sorted(queued_ids)} "
-            f"| running {sorted(running_ids)}"
+            f"| running {sorted(running_ids)} | staged {sorted(staged_ids)}"
+        )
+        assert not staged_ids & (queued_ids | running_ids), (
+            f"request staged while queued/running: "
+            f"{sorted(staged_ids & (queued_ids | running_ids))}"
         )
         assert self.pool.holders() - {PREFIX_HOLDER} <= running_ids, (
             "page leak: pages held by non-running requests "
@@ -3074,6 +3187,9 @@ class Engine:
         if not idle:
             return
         assert not running_ids and not queued_ids, "engine not idle"
+        assert not staged_ids, (
+            f"engine idle with staged post-decode work: {sorted(staged_ids)}"
+        )
         # pending entries are bare slots (split) or (slot, kind) tuples
         # (fused); normalize before the identity check
         pending_slots = [] if self._pending is None else [
@@ -3099,6 +3215,8 @@ class Engine:
             sum(bool(s) and s.phase == _PREFILL for s in self.slots),
         )
         self.gauges.set("serve.queued", len(self.sched))
+        if self.postdecode is not None:
+            self.gauges.set("serve.stage.queued", len(self.postdecode))
         if self.spec:
             self.gauges.set(
                 "serve.spec_accept_frac",

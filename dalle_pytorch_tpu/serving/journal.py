@@ -44,6 +44,8 @@ Pure host-side, no jax import — unit-testable like the scheduler.
 
 from __future__ import annotations
 
+import base64
+import hashlib
 import json
 import os
 from pathlib import Path
@@ -62,7 +64,40 @@ from .types import Request
 
 _ADMITTED = "admitted"
 _OUTCOME = "outcome"
-_KINDS = (_ADMITTED, _OUTCOME)
+# Stage-boundary records (docs/DESIGN.md §8.5): one per COMPLETED
+# post-decode stage boundary — ``stage="tokens"`` carries the finished
+# image tokens, ``stage="vae_decode"`` the decoded image — so a crash
+# mid-VAE or mid-rerank replays from the last completed stage instead of
+# re-running it. Duplicates are legal (failover re-announces); the
+# loader keeps the LAST record per (request, stage).
+_STAGE = "stage"
+_KINDS = (_ADMITTED, _OUTCOME, _STAGE)
+
+
+def image_to_payload(image: np.ndarray) -> dict:
+    """JSON-able encoding of a decoded image: raw bytes (base64) plus
+    shape/dtype and a content digest so bit rot is detected on load,
+    not silently decoded into a wrong image."""
+    arr = np.ascontiguousarray(image)
+    raw = arr.tobytes()
+    return {
+        "b64": base64.b64encode(raw).decode("ascii"),
+        "shape": list(arr.shape),
+        "dtype": str(arr.dtype),
+        "sha256": hashlib.sha256(raw).hexdigest(),
+    }
+
+
+def image_from_payload(payload: dict) -> np.ndarray:
+    """Inverse of ``image_to_payload``; raises ``JournalCorrupt`` on a
+    digest mismatch (a stage record that decodes wrong is bit rot — the
+    mid-file corruption class, never a torn tail)."""
+    raw = base64.b64decode(payload["b64"])
+    if hashlib.sha256(raw).hexdigest() != payload["sha256"]:
+        raise JournalCorrupt("stage image payload digest mismatch")
+    return np.frombuffer(raw, dtype=np.dtype(payload["dtype"])).reshape(
+        payload["shape"]
+    ).copy()
 
 
 class JournalCorrupt(RuntimeError):
@@ -162,6 +197,27 @@ class RequestJournal:
             "outcome": outcome, "t": float(now),
         })
 
+    def append_stage(self, request_id: str, stage: str, payload: dict,
+                     now: float) -> None:
+        """Record one completed post-decode stage boundary. ``payload``
+        may carry raw arrays — ``{"tokens": ids}`` or
+        ``{"image": ndarray}`` — which are encoded durably here
+        (``image_to_payload``), so the pipeline's ``on_stage`` hook can
+        hand over its in-memory values verbatim."""
+        enc: dict = {}
+        for k, v in payload.items():
+            if k == "image":
+                enc[k] = image_to_payload(np.asarray(v, np.float32))
+            elif isinstance(v, np.ndarray):
+                enc[k] = [int(t) for t in v.reshape(-1)]
+            else:
+                enc[k] = v
+        self._append({
+            "kind": _STAGE, "request_id": request_id, "stage": stage,
+            "payload": enc, "t": float(now),
+        })
+        counters.inc("serve.stage.journal_records")
+
     def seal(self) -> None:
         """Graceful-shutdown flush: close the handle and write the
         sidecar manifest (two-phase: the artifact is complete before the
@@ -249,13 +305,29 @@ class RequestJournal:
         for rec in records:
             if rec["kind"] == _ADMITTED:
                 admitted.setdefault(rec["request_id"], rec)
-            else:
+            elif rec["kind"] == _OUTCOME:
                 done.add(rec["request_id"])
+            # _STAGE records mark progress, not completion
         return [
             request_from_record(rec, now=now)
             for rid, rec in admitted.items()
             if rid not in done
         ]
+
+    @classmethod
+    def stages(cls, path: str) -> Dict[str, Dict[str, dict]]:
+        """request_id -> {stage -> payload} for every journaled stage
+        boundary (last record per (request, stage) wins — failover
+        re-announcements are idempotent). A secondary read: never counts
+        torn tails."""
+        records, _ = cls.load(path, count=False)
+        out: Dict[str, Dict[str, dict]] = {}
+        for rec in records:
+            if rec["kind"] == _STAGE:
+                out.setdefault(rec["request_id"], {})[rec["stage"]] = (
+                    rec["payload"]
+                )
+        return out
 
     @classmethod
     def outcomes(cls, path: str) -> Dict[str, str]:
@@ -291,7 +363,8 @@ class RequestJournal:
 
 def replay_unfinished(path: str, submit: Callable[[Request], object],
                       reconcile: Optional[Callable[[str, str], None]] = None,
-                      now: Optional[float] = None) -> List[str]:
+                      now: Optional[float] = None,
+                      submit_staged: Optional[Callable] = None) -> List[str]:
     """Resubmit every unfinished journaled request through ``submit``
     (typically ``Router.submit`` on the restarted process), counting
     each under ``serve.journal.replayed``; returns the ids that were
@@ -304,13 +377,33 @@ def replay_unfinished(path: str, submit: Callable[[Request], object],
     outcome)`` — optional — receives every ALREADY-finished journaled
     outcome so a restart harness can hand clients their pre-crash
     results without re-running them (the idempotency half of the
-    contract)."""
+    contract).
+
+    ``submit_staged(request, tokens, image=None)`` — optional, typically
+    ``Router.submit_staged`` — receives every unfinished request whose
+    journal carries stage-boundary records (DESIGN.md §8.5): the request
+    resumes from the LAST completed post-decode stage (tokens done →
+    VAE_DECODE; image decoded → CLIP_RERANK) instead of re-running token
+    decode, which is what makes a crash mid-VAE or mid-rerank replay
+    idempotent AND cheap. Without ``submit_staged`` (or without stage
+    records) the request replays from the top — still bit-identical by
+    the sampling contract, just re-doing the work."""
     if reconcile is not None:
         for rid, outcome in RequestJournal.outcomes(path).items():
             reconcile(rid, outcome)
+    staged = RequestJournal.stages(path) if submit_staged is not None else {}
     replayed: List[str] = []
     for request in RequestJournal.unfinished(path, now=now):
-        if submit(request) is not None:
+        st = staged.get(request.request_id)
+        if st is not None and "tokens" in st:
+            tokens = np.asarray(st["tokens"]["tokens"], np.int32)
+            img_payload = st.get("vae_decode")
+            image = (None if img_payload is None
+                     else image_from_payload(img_payload["image"]))
+            res = submit_staged(request, tokens, image=image)
+        else:
+            res = submit(request)
+        if res is not None:
             continue  # typed reject: delivered via results, not replayed
         counters.inc("serve.journal.replayed")
         replayed.append(request.request_id)

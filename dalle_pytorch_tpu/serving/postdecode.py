@@ -1,0 +1,407 @@
+"""Post-decode request stages: VAE decode + CLIP rerank inside the engine.
+
+A request whose image tokens have completed does not leave the serving
+layer yet — it transitions through typed post-decode stages
+(docs/DESIGN.md §8.5)::
+
+    tokens complete -> VAE_DECODE -> [CLIP_RERANK] -> DONE
+
+with the same robustness contract the token path already carries:
+
+- **Subordinate to decode.** Stage work is metered by a per-iteration
+  stage budget that literally reuses :class:`~.scheduler.TokenBudget`
+  (``chunk=1``, budget in images): per engine iteration at most
+  ``budget`` staged images are dispatched, in at most one fixed-width
+  batched jit per stage, so the max decode-iteration gap stays within
+  the chunked-prefill interference bound — stage work can never stall
+  token decode for longer than one bounded stage dispatch.
+- **Typed faults + retry.** Each dispatch passes the fault sites
+  ``vae_decode_fail`` / ``rerank_fail`` / ``stage_timeout``
+  (utils/faults.py) and a real-elapsed timeout; a failed attempt backs
+  the item off by ``RetryPolicy.delay`` (deterministic — no rng — so
+  chaos replays are bit-reproducible).
+- **Graceful degradation, never unbounded queueing.** Retry exhaustion,
+  a full stage backlog, or fleet occupancy past the watermark completes
+  the request **typed-degraded** instead of stalling it:
+  ``COMPLETED_TOKENS_ONLY`` (no image yet) or ``COMPLETED_UNRANKED``
+  (image decoded, rerank skipped). Degradation is an outcome value, not
+  an exception — exactly the overload philosophy of the token path.
+- **Crash-replayable stage boundaries.** The ``on_stage`` hook fires at
+  every completed boundary (tokens -> pipeline, VAE -> image) with the
+  payload needed to resume; the router journals it
+  (``{"kind": "stage", ...}`` records, serving/journal.py) so a crash
+  mid-VAE or mid-rerank replays idempotently from the last completed
+  stage with bit-identical completed results.
+- **Fixed-shape stage jits.** ``serving.vae_decode`` and
+  ``serving.clip_rerank`` are batched fixed-width jits registered in
+  the trace contracts (tools/trace_contracts.json) under the standing
+  zero-in-trace-compile and donation budgets; partial batches are
+  padded host-side by repeating the tail row so one signature serves
+  every occupancy.
+
+Stretch hooks: ``stream_preview`` emits progressive partial results at
+each stage boundary, and staged work dispatches in ``(-priority, seq)``
+order so a low-priority offline lane (negative-priority requests via
+the existing priority machinery) naturally yields stage capacity to
+interactive traffic.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.faults import FAULTS
+from ..utils.resilience import RetryPolicy
+from ..utils.telemetry import TELEMETRY
+from .scheduler import Entry, TokenBudget
+from .types import Outcome
+
+# Stage names — journal record vocabulary (serving/journal.py) and the
+# state-machine states of DESIGN.md §8.5. STAGE_TOKENS marks the
+# tokens-complete boundary (entry INTO the pipeline), not a queue.
+STAGE_TOKENS = "tokens"
+STAGE_VAE = "vae_decode"
+STAGE_RERANK = "clip_rerank"
+
+
+# --------------------------------------------------------------- stage jits
+#
+# Module-level like the engine's own jits: the flax module is a static
+# (hashable) argument, so every engine sharing a module/config shares one
+# compiled executable per shape signature. Contract entries
+# serving.vae_decode / serving.clip_rerank (tools/lint/trace/registry.py)
+# pin the canonical signatures; no donation (inputs are host-built batches
+# reused nowhere else — donating would not save a buffer that matters).
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _vae_decode_jit(vae, params, img_seq):
+    """Token ids (S, n) -> pixels (S, H, W, C) via the VAE decoder."""
+    return vae.apply({"params": params}, img_seq, method="decode")
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _clip_rerank_jit(clip, params, text, images):
+    """Per-pair CLIP similarity (S,) for (S, L) text ids and (S, H, W, C)
+    pixels; resize to the CLIP visual resolution happens in-trace so the
+    stage is one dispatch regardless of the VAE's output size."""
+    n = images.shape[0]
+    imgs = jax.image.resize(
+        images,
+        (n, clip.visual_image_size, clip.visual_image_size, images.shape[-1]),
+        method="bilinear",
+    )
+    return clip.apply({"params": params}, text, imgs, text_mask=text != 0)
+
+
+@dataclass(frozen=True)
+class StageConfig:
+    """Operator knobs for the post-decode pipeline. Defaults are
+    permissive (watermark 1.0 = occupancy-triggered degradation off;
+    occupancy is <= 1.0 so only an explicit watermark < 1.0 arms it);
+    the backlog cap still bounds queueing unconditionally."""
+
+    batch: int = 2                  # fixed jit batch width per stage
+    budget: Optional[int] = None    # images/iteration (TokenBudget); None -> batch
+    queue_limit: int = 64           # staged backlog cap -> degrade at entry
+    high_watermark: float = 1.0     # fleet occupancy past this -> degrade at entry
+    retry: RetryPolicy = RetryPolicy(
+        attempts=3, base_delay=0.25, max_delay=2.0, jitter=0.0, retry_on=())
+    timeout_s: float = 30.0         # real-elapsed per-dispatch bound
+    rerank: bool = True             # run CLIP_RERANK when a CLIP is supplied
+
+    def __post_init__(self):
+        assert self.batch >= 1, self.batch
+        assert self.budget is None or self.budget >= 1, self.budget
+        assert self.queue_limit >= 1, self.queue_limit
+        assert self.retry.attempts >= 1, self.retry.attempts
+
+
+@dataclass(frozen=True)
+class StageSpec:
+    """The models the pipeline runs: a DiscreteVAE (required) and an
+    optional CLIP; ``Engine(..., stages=StageSpec(...))`` enables the
+    pipeline. ``clip=None`` (or ``config.rerank=False``) skips the
+    rerank stage — requests complete with an unscored image."""
+
+    vae: object
+    vae_params: object
+    clip: Optional[object] = None
+    clip_params: Optional[object] = None
+    config: StageConfig = StageConfig()
+
+
+@dataclass
+class _Staged:
+    """One request parked in the pipeline (holds NO kv pages — the slot
+    and its pages were released when tokens completed)."""
+
+    entry: Entry
+    tokens: np.ndarray              # completed image tokens (int32)
+    stage: str                      # STAGE_VAE | STAGE_RERANK
+    image: Optional[np.ndarray] = None
+    attempts: int = 0               # failures at the CURRENT stage
+    ready_at: float = 0.0           # clock time the next attempt may run
+
+
+class PostDecodePipeline:
+    """Host-side stage queue + batched dispatch. Owned by an Engine and
+    driven from ``Engine.step()`` (so, behind a Router, always under the
+    router lock — the ``on_stage`` journal hook needs no locking of its
+    own)."""
+
+    def __init__(self, spec: StageSpec, *, clock, counters, gauges,
+                 histograms, finish: Callable, occupancy=None):
+        if spec.vae is None:
+            raise ValueError("StageSpec.vae is required")
+        if spec.clip is not None and spec.clip_params is None:
+            raise ValueError("StageSpec.clip without clip_params")
+        self.spec = spec
+        self.cfg = spec.config
+        self.rerank = bool(self.cfg.rerank and spec.clip is not None)
+        self._clock = clock
+        self.counters = counters
+        self.gauges = gauges
+        self.histograms = histograms
+        # finish(entry, outcome, tokens, image=, score=, detail=) — the
+        # engine's _finish_staged; every staged request ends through it.
+        self._finish = finish
+        self._occupancy = occupancy
+        self._budget = TokenBudget(
+            budget=self.cfg.budget if self.cfg.budget is not None
+            else self.cfg.batch,
+            chunk=1,
+        )
+        self._staged: List[_Staged] = []
+        # Stage-boundary hook: on_stage(request_id, stage, payload) with
+        # payload {"tokens": [ids]} or {"image": np.ndarray}. The router
+        # binds this to its journal (crash replay) and failover state.
+        self.on_stage: Optional[Callable[[str, str, dict], None]] = None
+        # Stretch: progressive partial results —
+        # stream_preview(request_id, stage, value) per completed boundary.
+        self.stream_preview: Optional[Callable[[str, str, object], None]] = None
+
+    # ------------------------------------------------------------- introspection
+
+    def __len__(self) -> int:
+        return len(self._staged)
+
+    def __bool__(self) -> bool:
+        return bool(self._staged)
+
+    def ids(self) -> List[str]:
+        return [s.entry.request.request_id for s in self._staged]
+
+    # ------------------------------------------------------------------- entry
+
+    def enqueue(self, entry: Entry, tokens: np.ndarray,
+                image: Optional[np.ndarray] = None,
+                announce: bool = True) -> None:
+        """Park a tokens-complete request in the pipeline.
+
+        ``image`` resumes at CLIP_RERANK (journal replay / failover from
+        a vae_decode stage record); ``announce=False`` suppresses the
+        ``on_stage`` boundary hook for exactly those resume paths, whose
+        records are already durable."""
+        rid = entry.request.request_id
+        tokens = np.asarray(tokens, np.int32)
+        now = self._clock.now()
+        self.counters.inc("serve.stage.enqueued")
+        if announce and self.on_stage is not None:
+            self.on_stage(rid, STAGE_TOKENS, {"tokens": [int(t) for t in tokens]})
+        st = _Staged(entry=entry, tokens=tokens,
+                     stage=STAGE_RERANK if image is not None else STAGE_VAE,
+                     image=image, ready_at=now)
+        # Pressure degradation at the stage boundary: past-saturation
+        # requests complete typed-degraded instead of queueing unboundedly.
+        occ = self._occupancy() if self._occupancy is not None else 0.0
+        if len(self._staged) >= self.cfg.queue_limit:
+            self._degrade(st, "stage_backlog")
+            return
+        if occ > self.cfg.high_watermark:
+            self._degrade(st, "stage_watermark")
+            return
+        if st.stage == STAGE_RERANK and not self.rerank:
+            # resumed past VAE but rerank is off: already fully complete
+            self._complete(st, score=None, now=now)
+            return
+        self._staged.append(st)
+
+    # ------------------------------------------------------------------ sweeps
+
+    def sweep(self, cancelled_ids, now: float) -> List[str]:
+        """Terminate staged requests that were cancelled or whose deadline
+        passed (same semantics as a running row: the typed outcome carries
+        the partial results — tokens always, the image if VAE finished).
+        Returns the request ids of cancelled entries."""
+        hit = []
+        for st in list(self._staged):
+            rid = st.entry.request.request_id
+            ddl = st.entry.request.deadline
+            if rid in cancelled_ids:
+                self._staged.remove(st)
+                self._finish(st.entry, Outcome.CANCELLED, st.tokens,
+                             image=st.image, detail=f"cancelled in {st.stage}")
+                hit.append(rid)
+            elif ddl is not None and now > ddl:
+                self._staged.remove(st)
+                self._finish(st.entry, Outcome.DEADLINE_EXCEEDED, st.tokens,
+                             image=st.image, detail=f"deadline in {st.stage}")
+        return hit
+
+    # ---------------------------------------------------------------- dispatch
+
+    def step(self) -> bool:
+        """One iteration of stage work, budgeted. Rerank is head-of-line
+        (draining the furthest-along work frees pipeline capacity
+        fastest); within a stage, dispatch order is (-priority, seq) —
+        the offline lane yields to interactive requests."""
+        if not self._staged:
+            return False
+        now = self._clock.now()
+        order = sorted(self._staged,
+                       key=lambda s: (-s.entry.request.priority, s.entry.seq))
+        ready_rr = [s for s in order
+                    if s.stage == STAGE_RERANK and s.ready_at <= now]
+        ready_vae = [s for s in order
+                     if s.stage == STAGE_VAE and s.ready_at <= now]
+        grants = self._budget.plan(0, [len(ready_rr), len(ready_vae)])
+        worked = False
+        if grants[0]:
+            worked = self._dispatch(
+                STAGE_RERANK, ready_rr[:min(grants[0], self.cfg.batch)], now
+            ) or worked
+        if grants[1]:
+            worked = self._dispatch(
+                STAGE_VAE, ready_vae[:min(grants[1], self.cfg.batch)], now
+            ) or worked
+        return worked
+
+    def _dispatch(self, stage: str, batch: List[_Staged], now: float) -> bool:
+        if not batch:
+            return False
+        if stage == STAGE_VAE:
+            site, fired = "vae_decode_fail", FAULTS.take("vae_decode_fail")
+        else:
+            site, fired = "rerank_fail", FAULTS.take("rerank_fail")
+        if fired:
+            self.counters.inc(f"serve.fault_{site}")
+            self._retry_or_degrade(batch, now, site)
+            return True
+        if FAULTS.take("stage_timeout"):
+            self.counters.inc("serve.fault_stage_timeout")
+            self.counters.inc("serve.stage.timeouts")
+            self._retry_or_degrade(batch, now, "stage_timeout")
+            return True
+        t0 = time.monotonic()
+        span = ("serve.stage.vae_decode" if stage == STAGE_VAE
+                else "serve.stage.clip_rerank")
+        with TELEMETRY.span(span, n=len(batch)):
+            if stage == STAGE_VAE:
+                out = np.asarray(_vae_decode_jit(
+                    self.spec.vae, self.spec.vae_params,
+                    jnp.asarray(self._pad(np.stack([s.tokens for s in batch])))))
+            else:
+                texts = np.stack([self._clip_text(s.entry.request) for s in batch])
+                images = np.stack([s.image for s in batch])
+                out = np.asarray(_clip_rerank_jit(
+                    self.spec.clip, self.spec.clip_params,
+                    jnp.asarray(self._pad(texts)),
+                    jnp.asarray(self._pad(images))))
+        if time.monotonic() - t0 > self.cfg.timeout_s:
+            self.counters.inc("serve.stage.timeouts")
+            self._retry_or_degrade(batch, now, "stage_timeout")
+            return True
+        for i, st in enumerate(batch):
+            st.attempts = 0
+            rid = st.entry.request.request_id
+            if stage == STAGE_VAE:
+                st.image = np.asarray(out[i], np.float32)
+                self.counters.inc("serve.stage.vae_images")
+                if self.on_stage is not None:
+                    self.on_stage(rid, STAGE_VAE, {"image": st.image})
+                if self.stream_preview is not None:
+                    self.stream_preview(rid, STAGE_VAE, st.image)
+                if self.rerank:
+                    st.stage = STAGE_RERANK
+                    st.ready_at = now
+                else:
+                    self._staged.remove(st)
+                    self._complete(st, score=None, now=now)
+            else:
+                self.counters.inc("serve.stage.reranked")
+                score = float(out[i])
+                if self.stream_preview is not None:
+                    self.stream_preview(rid, STAGE_RERANK, score)
+                self._staged.remove(st)
+                self._complete(st, score=score, now=now)
+        return True
+
+    def warmup(self) -> None:
+        """Pay both stage-jit compiles at the canonical batch width (the
+        bench's zero-in-trace-compile window assumes this ran)."""
+        n = self.spec.vae.image_seq_len
+        seqs = jnp.zeros((self.cfg.batch, n), jnp.int32)
+        imgs = _vae_decode_jit(self.spec.vae, self.spec.vae_params, seqs)
+        if self.rerank:
+            texts = jnp.zeros((self.cfg.batch, self.spec.clip.text_seq_len),
+                              jnp.int32)
+            _clip_rerank_jit(self.spec.clip, self.spec.clip_params,
+                             texts, imgs).block_until_ready()
+        else:
+            imgs.block_until_ready()
+
+    # ----------------------------------------------------------------- helpers
+
+    def _pad(self, rows: np.ndarray) -> np.ndarray:
+        """Pad a partial batch to the fixed jit width by repeating the
+        tail row — one shape signature per stage, every occupancy."""
+        short = self.cfg.batch - rows.shape[0]
+        if short <= 0:
+            return rows
+        return np.concatenate([rows, np.repeat(rows[-1:], short, axis=0)], axis=0)
+
+    def _clip_text(self, request) -> np.ndarray:
+        """The rerank text is the request's own prompt row, truncated or
+        zero-padded to the CLIP text length — one shared rerank path for
+        the engine and the CLI (generate.py submits the tokenizer row as
+        the prompt, so both see the same ids)."""
+        L = self.spec.clip.text_seq_len
+        row = np.zeros((L,), np.int32)
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        n = min(L, prompt.shape[0])
+        row[:n] = prompt[:n]
+        return row
+
+    def _retry_or_degrade(self, batch: List[_Staged], now: float,
+                          site: str) -> None:
+        for st in batch:
+            st.attempts += 1
+            if st.attempts >= self.cfg.retry.attempts:
+                self._staged.remove(st)
+                self._degrade(st, site)
+            else:
+                self.counters.inc("serve.stage.retries")
+                st.ready_at = now + self.cfg.retry.delay(st.attempts - 1)
+
+    def _degrade(self, st: _Staged, detail: str) -> None:
+        self.counters.inc("serve.stage.degraded")
+        if st.image is None:
+            self._finish(st.entry, Outcome.COMPLETED_TOKENS_ONLY, st.tokens,
+                         detail=detail)
+        else:
+            self._finish(st.entry, Outcome.COMPLETED_UNRANKED, st.tokens,
+                         image=st.image, detail=detail)
+
+    def _complete(self, st: _Staged, score: Optional[float], now: float) -> None:
+        self.histograms.observe("serve.stage.request_to_image_s",
+                                max(0.0, now - st.entry.submit_time))
+        self._finish(st.entry, Outcome.COMPLETED, st.tokens,
+                     image=st.image, score=score)

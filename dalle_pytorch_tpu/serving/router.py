@@ -116,6 +116,8 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Callable, Dict, List, Optional
 
+import numpy as np
+
 from ..utils.faults import FAULTS
 from ..utils.metrics import counters, gauges, histograms
 from ..utils.resilience import RetryPolicy, retry_after_hint
@@ -211,6 +213,12 @@ class _RouterEntry:
     # set when a replica death requeued this entry; cleared (and observed
     # into router.failover_latency_s) at the failover dispatch
     crash_t0: Optional[float] = None
+    # completed post-decode stage payloads (stage -> {"tokens": ids} /
+    # {"image": ndarray}), mirrored from the pipeline's on_stage hook: a
+    # FAILOVER of a staged request re-dispatches it from its last
+    # completed stage (engine.submit_staged) instead of re-decoding —
+    # the in-memory twin of the journal's stage records
+    staged: Dict[str, dict] = field(default_factory=dict)
 
     @property
     def request_id(self) -> str:
@@ -306,7 +314,8 @@ class Router:
                  engine_config: EngineConfig = EngineConfig(),
                  clock: Optional[Clock] = None,
                  journal: Optional[RequestJournal] = None,
-                 engine_factory: Optional[Callable[..., Engine]] = None):
+                 engine_factory: Optional[Callable[..., Engine]] = None,
+                 stages=None):
         assert config.n_replicas >= 1, config.n_replicas
         self.config = config
         self._lock = threading.RLock()
@@ -316,6 +325,11 @@ class Router:
         self._dalle = dalle
         self._params = params
         self._engine_config = engine_config
+        # post-decode stages (serving/postdecode.py): a StageSpec enables
+        # VAE decode + CLIP rerank on every replica engine; the router
+        # binds each pipeline's stage-boundary hook to the journal and to
+        # its failover bookkeeping (_RouterEntry.staged)
+        self._stages = stages
         # replica construction seam: tools/traffic_sim.py substitutes a
         # modeled StubEngine fleet under the REAL router policy (health
         # machine, breaker, respawn, failover, shed). Called with
@@ -354,11 +368,18 @@ class Router:
                 metric_labels={"replica": str(rid)},
                 fleet_occupancy=self.fleet_occupancy,
             )
-        return Engine(
+        eng = Engine(
             self._dalle, self._params, self._engine_config,
             clock=self.clock, metric_labels={"replica": str(rid)},
             fleet_occupancy=self.fleet_occupancy,
+            stages=self._stages,
         )
+        if eng.postdecode is not None:
+            # stage boundaries flow to the journal + failover state;
+            # pipelines step inside engine.step(), which only runs under
+            # the router lock — the RLock makes the re-entry safe
+            eng.postdecode.on_stage = self._on_stage
+        return eng
 
     # ------------------------------------------------------------ public
 
@@ -409,6 +430,59 @@ class Router:
                 # journal AFTER every typed-reject gate: the WAL holds
                 # exactly the requests the fleet owes a terminal outcome
                 self._journal.append_admitted(request, now)
+            self._queue.append(entry)
+            self._live.add(request.request_id)
+            return None
+
+    def submit_staged(self, request: Request, tokens,
+                      image=None) -> Optional[RequestResult]:
+        """Queue a request whose token work is already done — the crash
+        replay resume path (``replay_unfinished(submit_staged=...)``): it
+        dispatches straight into a replica's post-decode pipeline at the
+        stage after its last journaled boundary. Same typed contract as
+        ``submit``."""
+        if self._stages is None:
+            raise ValueError("router built without stages=StageSpec(...)")
+        with self._lock:
+            if request.request_id in self.results or request.request_id in self._live:
+                raise ValueError(f"duplicate request_id {request.request_id!r}")
+            self._submitted += 1
+            counters.inc("router.submitted")
+            now = self.clock.now()
+            self._spans[request.request_id] = TELEMETRY.begin(
+                "router.request", request_id=request.request_id,
+                priority=request.priority,
+            )
+            entry = _RouterEntry(request=request, seq=self._seq,
+                                 submit_time=now)
+            self._seq += 1
+            entry.staged["tokens"] = {
+                "tokens": [int(t) for t in np.asarray(tokens).reshape(-1)]
+            }
+            if image is not None:
+                entry.staged["vae_decode"] = {"image": image}
+            live = [
+                r for r in self._replicas if r.state is not ReplicaState.DEAD
+            ]
+            if not live:
+                return self._reject_locked(entry, RejectReason.NO_REPLICA)
+            # no page demand gate: staged work holds no kv pages
+            if len(self._queue) >= self.config.queue_limit:
+                TELEMETRY.event(
+                    "router.shed", request_id=request.request_id,
+                    queued=len(self._queue),
+                )
+                counters.inc("router.shed")
+                return self._reject_locked(entry, RejectReason.QUEUE_FULL)
+            if self._journal is not None:
+                self._journal.append_admitted(request, now)
+                # re-append the stage boundaries so THIS journal is
+                # self-contained (idempotent: the loader keeps the last
+                # record per stage)
+                for stage, payload in entry.staged.items():
+                    self._journal.append_stage(
+                        request.request_id, stage, payload, now
+                    )
             self._queue.append(entry)
             self._live.add(request.request_id)
             return None
@@ -576,6 +650,7 @@ class Router:
                     and not r.inflight
                     and not any(r.engine.slots)
                     and not len(r.engine.sched)
+                    and not getattr(r.engine, "postdecode", None)
                 ):
                     r.state = ReplicaState.DEAD
                     r.death_reason = "drained"
@@ -957,10 +1032,17 @@ class Router:
         self._queue.sort(key=lambda e: (-e.request.priority, e.seq))
         while self._queue:
             entry = self._queue[0]
+            # a staged entry (completed stage payloads from the journal or
+            # a dead replica) resumes INSIDE a pipeline, not a slot — its
+            # admission gate and submit path differ
+            staged = "tokens" in entry.staged
             candidates = [
                 r for r in self._replicas
                 if r.state is ReplicaState.HEALTHY
-                and r.engine.can_admit(entry.request)
+                and (
+                    r.engine.can_admit_staged(entry.request) if staged
+                    else r.engine.can_admit(entry.request)
+                )
             ]
             if not candidates:
                 return
@@ -977,7 +1059,15 @@ class Router:
                     latency_s=latency, failovers=entry.failovers,
                 )
                 entry.crash_t0 = None
-            rejected = r.engine.submit(entry.request)
+            if staged:
+                img = entry.staged.get("vae_decode")
+                rejected = r.engine.submit_staged(
+                    entry.request,
+                    np.asarray(entry.staged["tokens"]["tokens"], np.int32),
+                    image=None if img is None else img["image"],
+                )
+            else:
+                rejected = r.engine.submit(entry.request)
             if rejected is not None:
                 # can_admit said yes but the engine refused — surface the
                 # engine's typed reason rather than hiding a router bug
@@ -1014,6 +1104,23 @@ class Router:
         )
         self._finish_locked(entry, result)
         return result
+
+    def _on_stage(self, request_id: str, stage: str, payload: dict) -> None:
+        """Stage-boundary sink for every replica pipeline: journal the
+        record durably (crash replay) and mirror it onto the in-flight
+        entry (replica failover). Called from inside ``engine.step()``,
+        which already holds the router lock — the RLock re-entry is
+        free."""
+        with self._lock:
+            if self._journal is not None:
+                self._journal.append_stage(
+                    request_id, stage, payload, self.clock.now()
+                )
+            for r in self._replicas:
+                entry = r.inflight.get(request_id)
+                if entry is not None:
+                    entry.staged[stage] = payload
+                    break
 
     def _finish_locked(self, entry: _RouterEntry, result: RequestResult) -> None:
         assert entry.request_id not in self.results, (

@@ -37,6 +37,15 @@ class Outcome(str, Enum):
     CANCELLED = "cancelled"
     PREEMPT_CAP = "preempt_cap"
     PREFILL_FAILED = "prefill_failed"
+    # Typed-DEGRADED completions from the post-decode pipeline
+    # (serving/postdecode.py, DESIGN.md §8.5): the token work succeeded
+    # but a post-decode stage was shed — by retry exhaustion, backlog, or
+    # fleet pressure past the stage watermark. Tokens (and, for UNRANKED,
+    # the decoded image) are complete and bit-exact; only the shed stage's
+    # value is missing. These are successes of the degradation policy,
+    # not failures.
+    COMPLETED_TOKENS_ONLY = "completed_tokens_only"  # image never decoded
+    COMPLETED_UNRANKED = "completed_unranked"        # image, no CLIP score
 
 
 class RejectReason(str, Enum):
@@ -94,6 +103,14 @@ class RequestResult:
     # derived from fleet occupancy and the respawn ladder. None on every
     # other outcome — DEMAND_EXCEEDS_POOL is permanent, retrying is futile.
     retry_after_s: Optional[float] = None
+    # post-decode pipeline results (serving/postdecode.py): the decoded
+    # image (H, W, C float32, VAE-normalized space — denormalize() to
+    # display) and the CLIP rerank score. image is set on COMPLETED and
+    # COMPLETED_UNRANKED (and on mid-stage cancel/deadline partials when
+    # VAE had finished); rerank_score only on fully-COMPLETED reranked
+    # requests. Both None when the engine runs without stages.
+    image: Optional[np.ndarray] = None
+    rerank_score: Optional[float] = None
     detail: str = ""
 
     def to_json(self) -> dict:
@@ -101,6 +118,9 @@ class RequestResult:
             "request_id": self.request_id,
             "outcome": self.outcome.value,
             "n_tokens": None if self.tokens is None else int(len(self.tokens)),
+            # the image itself stays out of JSON; shape documents presence
+            "image_shape": None if self.image is None else list(self.image.shape),
+            "rerank_score": self.rerank_score,
             "reject_reason": (
                 None if self.reject_reason is None else self.reject_reason.value
             ),

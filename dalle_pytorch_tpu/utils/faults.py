@@ -81,6 +81,19 @@ Sites in use:
                  matches its chain digest) — the whole snapshot is
                  REJECTED (``serve.snapshot.rejected``) and the engine
                  falls back to a cold index, never mapping corrupt K/V
+``vae_decode_fail`` ``serving.postdecode``: one VAE_DECODE stage
+                 dispatch fails transiently — the batch retries with
+                 backoff; exhaustion completes the requests typed
+                 ``completed_tokens_only`` (graceful degradation,
+                 DESIGN.md §8.5), never stalled or dropped
+``rerank_fail``  ``serving.postdecode``: one CLIP_RERANK stage dispatch
+                 fails transiently — retries with backoff; exhaustion
+                 completes the requests typed ``completed_unranked``
+                 (the decoded image survives, only the score is shed)
+``stage_timeout`` ``serving.postdecode``: one stage dispatch exceeds its
+                 per-dispatch time budget — same retry-then-degrade
+                 path as a stage failure, counted separately
+                 (``serve.stage.timeouts``)
 ===============  =============================================================
 
 Injection must be impossible to leave on by accident: the registry is
@@ -111,6 +124,7 @@ KNOWN_SITES = frozenset({
     "prefix_hash_collide", "prefix_publish_fail",
     "spec_verify_abort",
     "replica_respawn_fail", "journal_torn", "snapshot_corrupt",
+    "vae_decode_fail", "rerank_fail", "stage_timeout",
 })
 
 

@@ -41,6 +41,11 @@ SPANS = frozenset({
     "serve.iteration",      # one per fused ragged iteration (one dispatch)
     "serve.spec_verify",    # one per speculative iteration: draft+verify+
                             # accept dispatch and its synchronous readback
+    # post-decode pipeline (serving/postdecode.py): one span per batched
+    # stage dispatch — the auto "<span>_s" histograms ARE the per-stage
+    # latency distributions
+    "serve.stage.vae_decode",
+    "serve.stage.clip_rerank",
     # replicated front door (serving/router.py)
     "router.request",       # router submit -> typed outcome
     # trainer (train_dalle.py)
@@ -94,6 +99,8 @@ COUNTERS = frozenset({
     "serve.cancelled",
     "serve.preempt_cap",
     "serve.prefill_failed",
+    "serve.completed_tokens_only",
+    "serve.completed_unranked",
     # typed-reject tallies (f"serve.rejected.{reason.value}" expansions)
     "serve.rejected.demand_exceeds_pool",
     "serve.rejected.queue_full",
@@ -114,6 +121,17 @@ COUNTERS = frozenset({
     "serve.fault_spec_verify_abort",
     "serve.fault_journal_torn",
     "serve.fault_snapshot_corrupt",
+    "serve.fault_vae_decode_fail",
+    "serve.fault_rerank_fail",
+    "serve.fault_stage_timeout",
+    # post-decode pipeline (serving/postdecode.py; DESIGN.md §8.5)
+    "serve.stage.enqueued",        # requests entering the pipeline
+    "serve.stage.vae_images",      # VAE_DECODE stage completions (images)
+    "serve.stage.reranked",        # CLIP_RERANK stage completions (scores)
+    "serve.stage.retries",         # failed stage attempts backed off
+    "serve.stage.timeouts",        # dispatches past the stage time budget
+    "serve.stage.degraded",        # typed-degraded completions (both kinds)
+    "serve.stage.journal_records", # stage-boundary WAL records written
     # crash recovery (serving/journal.py + engine snapshot; §8.3)
     "serve.journal.appended",   # admitted-request WAL records written
     "serve.journal.replayed",   # unfinished requests resubmitted on restart
@@ -157,6 +175,8 @@ COUNTERS = frozenset({
     "router.cancelled",
     "router.preempt_cap",
     "router.prefill_failed",
+    "router.completed_tokens_only",
+    "router.completed_unranked",
     # trainer
     "train.nan_skips",
     # data paths (the webdata.* names data.* events carry; DESIGN.md §8)
@@ -180,6 +200,7 @@ GAUGES = frozenset({
     "serve.running",
     "serve.prefilling",
     "serve.queued",
+    "serve.stage.queued",        # requests parked in the post-decode pipeline
     "serve.prefix_hit_frac",     # hits / (hits + misses), lifetime
     "serve.prefix_pages",        # pages currently held by the index
     "serve.spec_accept_frac",    # accepted / drafted, lifetime
@@ -203,6 +224,9 @@ HISTOGRAMS = frozenset({
     "serve.ttft_s",
     "serve.request_latency_s",
     "serve.completed_latency_s",
+    # request -> image end-to-end latency: submit to full-pipeline DONE
+    # (image-bearing completions only; DESIGN.md §8.5)
+    "serve.stage.request_to_image_s",
     "router.failover_latency_s",
     # TTFT split by prefix-cache hit class (serve.ttft_s still carries
     # every request; bench's cached-vs-cold comparison reads these)

@@ -63,13 +63,17 @@ def parse_args():
     return parser.parse_args()
 
 
-def _engine_image_tokens(engine, dalle, prompt_row, num_images, tag, seed):
-    """Generate ``num_images`` image-token sequences for one prompt through
-    the (shared, reused across prompts) serving engine: one Request per
-    image, each with its own (seed, position)-addressed sampling stream and
-    a per-prompt ``tag`` namespacing its id. Every request must COMPLETE
-    here (no deadlines, default pool) — any other outcome is a bug surfaced
-    as a RuntimeError, never a silently missing image."""
+def _engine_images(engine, dalle, prompt_row, num_images, tag, seed):
+    """Generate ``num_images`` images for one prompt through the (shared,
+    reused across prompts) serving engine and its post-decode pipeline:
+    one Request per image, each with its own (seed, position)-addressed
+    sampling stream and a per-prompt ``tag`` namespacing its id. Tokens,
+    the VAE decode, and (when the engine carries a CLIP) the rerank score
+    all come back on the RequestResult — the CLI and production serving
+    share ONE rerank path (serving/postdecode.py). Every request must
+    COMPLETE here (no deadlines, roomy stage queue, default pool) — any
+    other outcome, including a typed-degraded one, is a bug surfaced as a
+    RuntimeError, never a silently missing image."""
     import numpy as np
 
     from dalle_pytorch_tpu.serving import Outcome, Request
@@ -90,7 +94,13 @@ def _engine_image_tokens(engine, dalle, prompt_row, num_images, tag, seed):
     }
     if bad:
         raise RuntimeError(f"engine failed requests: {bad}")
-    return np.stack([results[rid].tokens for rid in ids])
+    images = np.stack([results[rid].image for rid in ids])
+    scores = None
+    if engine.postdecode is not None and engine.postdecode.rerank:
+        scores = np.asarray(
+            [results[rid].rerank_score for rid in ids], np.float32
+        )
+    return images, scores
 
 
 def main():
@@ -153,15 +163,19 @@ def main():
     outputs_dir = Path(args.outputs_dir)
 
     key = jax.random.key(args.seed)
-    decode = jax.jit(
-        lambda seq: vae.apply({"params": vae_params}, seq, method="decode")
-    )
 
     # ONE engine reused across prompts (the decode caches are allocated at
-    # construction); gMLP models get the fused-scan fallback instead
+    # construction). The VAE decode and CLIP rerank ride as post-decode
+    # STAGES on the engine (serving/postdecode.py) so the CLI and
+    # production serving share one request→image path; the stage queue is
+    # sized to the full image count so no request ever hits the typed
+    # backlog-degrade policy here. gMLP models get the fused-scan fallback
+    # with an ad-hoc decode/rerank instead.
     engine = None
     try:
-        from dalle_pytorch_tpu.serving import Engine, EngineConfig
+        from dalle_pytorch_tpu.serving import (
+            Engine, EngineConfig, StageConfig, StageSpec,
+        )
 
         engine = Engine(
             dalle, params,
@@ -171,12 +185,25 @@ def main():
                 filter_thres=args.top_k,
                 temperature=args.temperature,
             ),
+            stages=StageSpec(
+                vae, vae_params, clip, clip_params,
+                config=StageConfig(
+                    batch=args.batch_size,
+                    queue_limit=max(args.num_images, 1),
+                ),
+            ),
         )
     except EngineUnsupportedModel as e:
         print(
             f"serving engine unavailable for this model ({e}); "
             "falling back to the fused scan decoder",
             file=sys.stderr,
+        )
+
+    decode = None
+    if engine is None:
+        decode = jax.jit(
+            lambda seq: vae.apply({"params": vae_params}, seq, method="decode")
         )
 
     for pi, text in enumerate(texts):
@@ -195,10 +222,17 @@ def main():
         )[0]
 
         if engine is not None:
-            seqs = _engine_image_tokens(
+            images, scores = _engine_images(
                 engine, dalle, prompt_row, args.num_images, tag=f"p{pi}",
                 seed=args.seed * 1_000_003 + pi * 65_537,
             )
+            images = denormalize(images, getattr(vae, "normalization", None))
+            if scores is not None:
+                # rerank: save best-scoring generations first (reference
+                # dalle_pytorch.py:503-505); the scores were produced by
+                # the engine's post-decode stage, so the CLI ordering and
+                # serving's rerank agree bit-for-bit
+                images = images[np.argsort(-scores)]
         else:
             tokens = jnp.asarray(
                 np.repeat(prompt_row[None], args.batch_size, axis=0)
@@ -212,36 +246,39 @@ def main():
                 )))
             seqs = np.concatenate(chunks)[: args.num_images]
 
-        images = []
-        for s in range(0, len(seqs), args.batch_size):
-            chunk = seqs[s : s + args.batch_size]
-            n = len(chunk)
-            if n < args.batch_size:  # pad the ragged tail for the jit shape
-                chunk = np.concatenate(
-                    [chunk, np.repeat(chunk[-1:], args.batch_size - n, axis=0)]
+            images = []
+            for s in range(0, len(seqs), args.batch_size):
+                chunk = seqs[s : s + args.batch_size]
+                n = len(chunk)
+                if n < args.batch_size:  # pad ragged tail for the jit shape
+                    chunk = np.concatenate(
+                        [chunk,
+                         np.repeat(chunk[-1:], args.batch_size - n, axis=0)]
+                    )
+                images.append(np.asarray(decode(jnp.asarray(chunk)))[:n])
+            images = np.concatenate(images)
+
+            images = denormalize(images, getattr(vae, "normalization", None))
+
+            if clip is not None:
+                # fallback-only ad-hoc rerank (the engine path gets its
+                # scores from the shared post-decode stage instead)
+                clip_imgs = jax.image.resize(
+                    jnp.asarray(images),
+                    (len(images), clip.visual_image_size,
+                     clip.visual_image_size, 3),
+                    method="bilinear",
                 )
-            images.append(np.asarray(decode(jnp.asarray(chunk)))[:n])
-        images = np.concatenate(images)
-
-        images = denormalize(images, getattr(vae, "normalization", None))
-
-        if clip is not None:
-            # rerank: save best-scoring generations first (reference
-            # dalle_pytorch.py:503-505)
-            clip_imgs = jax.image.resize(
-                jnp.asarray(images),
-                (len(images), clip.visual_image_size, clip.visual_image_size, 3),
-                method="bilinear",
-            )
-            clip_text = jnp.asarray(
-                tokenizer.tokenize([text], clip.text_seq_len, truncate_text=True)
-            ).repeat(len(images), axis=0)
-            scores = clip.apply(
-                {"params": clip_params}, clip_text, clip_imgs,
-                text_mask=clip_text != 0,
-            )
-            order = np.argsort(-np.asarray(scores))
-            images = images[order]
+                clip_text = jnp.asarray(
+                    tokenizer.tokenize(
+                        [text], clip.text_seq_len, truncate_text=True
+                    )
+                ).repeat(len(images), axis=0)
+                scores = clip.apply(
+                    {"params": clip_params}, clip_text, clip_imgs,
+                    text_mask=clip_text != 0,
+                )
+                images = images[np.argsort(-np.asarray(scores))]
 
         sub_dir = outputs_dir / text.replace(" ", "_")[:100]
         sub_dir.mkdir(parents=True, exist_ok=True)

@@ -1,0 +1,333 @@
+"""Post-decode pipeline tests (serving/postdecode.py; DESIGN §8.5): the
+VAE-decode -> CLIP-rerank stages pinned deterministically on CPU — full
+tokens->image->score completion with bit-identical reruns, typed fault
+retry and retry-exhaustion degradation (COMPLETED_TOKENS_ONLY /
+COMPLETED_UNRANKED), backlog and occupancy-watermark degradation at the
+stage boundary, cancel/deadline sweeps mid-stage, the per-iteration
+stage budget, journaled stage boundaries, and the ``submit_staged``
+crash-replay resume path producing bit-identical completed results.
+
+Every test arming stage faults runs on ``FakeClock(step_dt>0)`` — retry
+backoff is clock-elapsed and a real clock never advances enough inside
+a tight drive loop.
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from serve_smoke import build_tiny_model, build_tiny_stages  # noqa: E402
+
+from dalle_pytorch_tpu.serving import (  # noqa: E402
+    Engine,
+    EngineConfig,
+    FakeClock,
+    Outcome,
+    PostDecodePipeline,
+    Request,
+    StageConfig,
+    StageSpec,
+)
+from dalle_pytorch_tpu.serving.journal import (  # noqa: E402
+    RequestJournal,
+    image_from_payload,
+    replay_unfinished,
+)
+from dalle_pytorch_tpu.serving.postdecode import (  # noqa: E402
+    STAGE_RERANK,
+    STAGE_TOKENS,
+    STAGE_VAE,
+)
+from dalle_pytorch_tpu.serving.scheduler import Entry  # noqa: E402
+from dalle_pytorch_tpu.utils.faults import FAULTS  # noqa: E402
+from dalle_pytorch_tpu.utils.metrics import counters, gauges, histograms  # noqa: E402
+from dalle_pytorch_tpu.utils.resilience import RetryPolicy  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def model():
+    """One (dalle, params) for the whole module — every engine test
+    shares the prefill/decode jit cache."""
+    return build_tiny_model()
+
+
+@pytest.fixture(scope="module")
+def stages():
+    """One canonical StageSpec (tiny VAE + CLIP, the trace-contract
+    configs) for the whole module — the stage jits compile once."""
+    return build_tiny_stages()
+
+
+def req(i, max_new=4, **kw):
+    kw.setdefault("seed", i)
+    rng = np.random.RandomState(100 + i)
+    return Request(
+        request_id=f"r{i}", prompt=rng.randint(1, 16, size=(4,)).astype(np.int32),
+        max_new_tokens=max_new, **kw,
+    )
+
+
+def staged_engine(model, stages, spec=None, **cfg_kw):
+    dalle, params = model
+    cfg_kw.setdefault("max_batch", 2)
+    cfg_kw.setdefault("prefill_chunk", 2)
+    return Engine(
+        dalle, params, EngineConfig(**cfg_kw),
+        clock=FakeClock(step_dt=0.05), stages=spec or stages,
+    )
+
+
+def run_all(engine, reqs):
+    for r in reqs:
+        assert engine.submit(r) is None
+    return engine.run()
+
+
+# -------------------------------------------------------- engine-level
+
+
+class TestPipelineCompletion:
+    def test_full_pipeline_bit_identical_rerun(self, model, stages):
+        """tokens -> VAE -> rerank completes with an image and a score,
+        and a fresh engine over the same seeds reproduces every field
+        bitwise (the determinism the chaos gate's references rely on)."""
+        results = run_all(staged_engine(model, stages), [req(i) for i in range(3)])
+        for i in range(3):
+            res = results[f"r{i}"]
+            assert res.outcome is Outcome.COMPLETED, res
+            assert res.image is not None and res.image.ndim == 3
+            assert res.rerank_score is not None
+        again = run_all(staged_engine(model, stages), [req(i) for i in range(3)])
+        for i in range(3):
+            a, b = results[f"r{i}"], again[f"r{i}"]
+            assert np.array_equal(a.tokens, b.tokens)
+            assert np.array_equal(a.image, b.image)
+            assert a.rerank_score == b.rerank_score
+        assert counters.get("serve.stage.vae_images") == 6
+        assert counters.get("serve.stage.reranked") == 6
+
+    def test_rerank_off_completes_unscored(self, model, stages):
+        """clip=None skips CLIP_RERANK: fully COMPLETED with an image
+        and no score (not a degraded outcome)."""
+        spec = StageSpec(stages.vae, stages.vae_params)
+        res = run_all(staged_engine(model, stages, spec=spec), [req(0)])["r0"]
+        assert res.outcome is Outcome.COMPLETED
+        assert res.image is not None and res.rerank_score is None
+        assert counters.get("serve.stage.reranked") == 0
+
+    def test_transient_fault_retries_then_completes(self, model, stages):
+        """One vae_decode_fail burns a retry, backoff elapses on the
+        FakeClock, and the request still fully completes."""
+        FAULTS.arm("vae_decode_fail", count=1)
+        res = run_all(staged_engine(model, stages), [req(0)])["r0"]
+        assert res.outcome is Outcome.COMPLETED
+        assert res.image is not None and res.rerank_score is not None
+        assert counters.get("serve.stage.retries") == 1
+        assert counters.get("serve.stage.degraded") == 0
+
+
+class TestDegradation:
+    def test_vae_retry_exhaustion_tokens_only(self, model, stages):
+        """Every VAE attempt fails -> the request degrades typed to
+        COMPLETED_TOKENS_ONLY with its tokens and no image, never
+        stalling the engine."""
+        FAULTS.arm("vae_decode_fail", count=3)  # == RetryPolicy.attempts
+        res = run_all(staged_engine(model, stages), [req(0)])["r0"]
+        assert res.outcome is Outcome.COMPLETED_TOKENS_ONLY, res
+        assert res.tokens is not None and res.image is None
+        assert res.rerank_score is None
+        assert counters.get("serve.stage.degraded") == 1
+        assert counters.get("serve.stage.retries") == 2
+        assert counters.get("serve.completed_tokens_only") == 1
+
+    def test_rerank_retry_exhaustion_unranked(self, model, stages):
+        """Rerank exhaustion keeps the decoded image: COMPLETED_UNRANKED
+        with image, no score."""
+        FAULTS.arm("rerank_fail", count=3)
+        res = run_all(staged_engine(model, stages), [req(0)])["r0"]
+        assert res.outcome is Outcome.COMPLETED_UNRANKED, res
+        assert res.image is not None and res.rerank_score is None
+        assert counters.get("serve.stage.vae_images") == 1
+        assert counters.get("serve.completed_unranked") == 1
+
+    def test_stage_timeout_site_degrades(self, model, stages):
+        """The shared stage_timeout site exhausts like a stage fault."""
+        FAULTS.arm("stage_timeout", count=6)  # both stages draw from it
+        res = run_all(staged_engine(model, stages), [req(0)])["r0"]
+        assert res.outcome is Outcome.COMPLETED_TOKENS_ONLY
+        assert counters.get("serve.stage.timeouts") >= 3
+
+
+# ------------------------------------------- pipeline-direct (no engine)
+
+
+def make_pipeline(stages, config=None, occupancy=None, clock=None):
+    spec = stages if config is None else StageSpec(
+        stages.vae, stages.vae_params, stages.clip, stages.clip_params,
+        config=config,
+    )
+    done = []
+    pipe = PostDecodePipeline(
+        spec, clock=clock or FakeClock(step_dt=0.05),
+        counters=counters, gauges=gauges, histograms=histograms,
+        finish=lambda entry, outcome, tokens, image=None, score=None,
+        detail=None: done.append(
+            (entry.request.request_id, outcome, image, score, detail)),
+        occupancy=occupancy,
+    )
+    return pipe, done
+
+
+def entry(i, **kw):
+    return Entry(request=req(i, **kw), submit_time=0.0, seq=i)
+
+
+def toks(i):
+    return np.full((4,), i % 12, np.int32)
+
+
+class TestStageBoundaryPressure:
+    def test_backlog_degrades_at_entry(self, stages):
+        """Backlog >= queue_limit completes the newcomer typed-degraded
+        at the door (tokens-only: it never reached the VAE)."""
+        pipe, done = make_pipeline(stages, config=StageConfig(queue_limit=2))
+        for i in range(3):
+            pipe.enqueue(entry(i), toks(i))
+        assert len(pipe) == 2 and len(done) == 1
+        rid, outcome, image, _, detail = done[0]
+        assert rid == "r2" and outcome is Outcome.COMPLETED_TOKENS_ONLY
+        assert image is None and detail == "stage_backlog"
+        assert counters.get("serve.stage.degraded") == 1
+
+    def test_watermark_degrades_at_entry(self, stages):
+        """Fleet occupancy past high_watermark sheds stage work typed;
+        a resumed item that already has its image keeps it (UNRANKED)."""
+        pipe, done = make_pipeline(
+            stages, config=StageConfig(high_watermark=0.5),
+            occupancy=lambda: 0.9,
+        )
+        pipe.enqueue(entry(0), toks(0))
+        img = np.zeros((4, 4, 3), np.float32)
+        pipe.enqueue(entry(1), toks(1), image=img)
+        assert [d[1] for d in done] == [
+            Outcome.COMPLETED_TOKENS_ONLY, Outcome.COMPLETED_UNRANKED,
+        ]
+        assert done[1][2] is img and done[1][4] == "stage_watermark"
+
+    def test_cancel_and_deadline_sweep_mid_stage(self, stages):
+        """Parked staged work honors cancellation and deadlines with the
+        partial results it holds (image iff VAE already ran)."""
+        pipe, done = make_pipeline(stages)
+        pipe.enqueue(entry(0), toks(0))
+        pipe.enqueue(entry(1, deadline=1e-9), toks(1),
+                     image=np.zeros((4, 4, 3), np.float32))
+        assert pipe.sweep({"r0"}, now=1.0) == ["r0"]
+        assert not pipe and len(done) == 2
+        by_rid = {d[0]: d for d in done}
+        assert by_rid["r0"][1] is Outcome.CANCELLED
+        assert by_rid["r0"][4] == f"cancelled in {STAGE_VAE}"
+        assert by_rid["r1"][1] is Outcome.DEADLINE_EXCEEDED
+        assert by_rid["r1"][2] is not None  # image survives onto the result
+        assert by_rid["r1"][4] == f"deadline in {STAGE_RERANK}"
+
+    def test_stage_budget_bounds_dispatch(self, stages):
+        """budget=1: one step dispatches at most one staged image even
+        with three parked — stage work cannot crowd out token decode."""
+        pipe, _ = make_pipeline(
+            stages, config=StageConfig(budget=1, retry=RetryPolicy(
+                attempts=1, base_delay=0.0, max_delay=0.0, jitter=0.0,
+                retry_on=())),
+        )
+        for i in range(3):
+            pipe.enqueue(entry(i), toks(i))
+        assert pipe.step()
+        assert counters.get("serve.stage.vae_images") == 1
+        assert len(pipe) == 3  # r0 advanced to RERANK, none completed
+
+    def test_rerank_dispatches_before_vae(self, stages):
+        """Rerank is head-of-line: the furthest-along item drains first,
+        freeing pipeline capacity fastest."""
+        pipe, done = make_pipeline(stages, config=StageConfig(budget=1))
+        pipe.enqueue(entry(0), toks(0))  # at VAE
+        pipe.enqueue(entry(1), toks(1), image=np.zeros((4, 4, 3), np.float32))
+        assert pipe.step()
+        assert [d[0] for d in done] == ["r1"]
+        assert done[0][1] is Outcome.COMPLETED and done[0][3] is not None
+        assert counters.get("serve.stage.vae_images") == 0
+
+    def test_stage_boundary_hook_fires(self, stages):
+        """on_stage announces tokens-complete and VAE boundaries with
+        resumable payloads — exactly what the router journals."""
+        pipe, done = make_pipeline(stages)
+        seen = []
+        pipe.on_stage = lambda rid, stage, payload: seen.append(
+            (rid, stage, sorted(payload)))
+        pipe.enqueue(entry(0), toks(0))
+        while not done:
+            assert pipe.step()
+        assert seen[0] == ("r0", STAGE_TOKENS, ["tokens"])
+        assert seen[1] == ("r0", STAGE_VAE, ["image"])
+        # resume paths are announce=False: already-durable records
+        pipe.enqueue(entry(1), toks(1), announce=False)
+        assert len(seen) == 2
+
+
+# ------------------------------------------------- crash-replay resume
+
+
+class TestStagedResume:
+    def test_submit_staged_bit_identical(self, model, stages):
+        """Resuming from a journaled boundary — tokens only (restart at
+        VAE) or tokens+image (restart at RERANK) — reproduces the
+        uninterrupted run's completed result bitwise."""
+        ref = run_all(staged_engine(model, stages), [req(0)])["r0"]
+        eng = staged_engine(model, stages)
+        assert eng.submit_staged(req(0), ref.tokens) is None
+        from_vae = eng.run()["r0"]
+        eng = staged_engine(model, stages)
+        assert eng.submit_staged(req(0), ref.tokens, image=ref.image) is None
+        from_rerank = eng.run()["r0"]
+        for res in (from_vae, from_rerank):
+            assert res.outcome is Outcome.COMPLETED
+            assert np.array_equal(res.tokens, ref.tokens)
+            assert np.array_equal(res.image, ref.image)
+            assert res.rerank_score == ref.rerank_score
+
+    def test_journal_records_stages_and_replay_is_idempotent(
+            self, model, stages, tmp_path):
+        """A journaled completed request leaves stage records for every
+        boundary; replay of a clean-shutdown journal re-admits nothing
+        (the idempotency half of crash replay)."""
+        from dalle_pytorch_tpu.serving import Router, RouterConfig
+
+        dalle, params = model
+        jpath = str(tmp_path / "requests.jsonl")
+        router = Router(
+            dalle, params,
+            RouterConfig(n_replicas=1, respawn=False),
+            EngineConfig(max_batch=2, prefill_chunk=2),
+            clock=FakeClock(step_dt=0.05),
+            journal=RequestJournal(jpath), stages=stages,
+        )
+        assert router.submit(req(0)) is None
+        res = router.run()["r0"]
+        assert res.outcome is Outcome.COMPLETED
+        router._journal.close()
+        recorded = RequestJournal.stages(jpath)["r0"]
+        assert sorted(recorded) == sorted([STAGE_TOKENS, STAGE_VAE])
+        assert recorded[STAGE_TOKENS]["tokens"] == [int(t) for t in res.tokens]
+        assert np.array_equal(
+            image_from_payload(recorded[STAGE_VAE]["image"]), res.image)
+        replayed = replay_unfinished(
+            jpath, submit=lambda r: (_ for _ in ()).throw(
+                AssertionError("finished request replayed")),
+            submit_staged=lambda r, tokens, image=None: (
+                _ for _ in ()).throw(
+                AssertionError("finished request replayed staged")),
+        )
+        assert replayed == []

@@ -577,11 +577,16 @@ def test_telemetry_smoke_gate(tmp_path):
     # prefix-cache cold/warm completions, 1 mid-prefill deadline drill,
     # + 6 from the recovery drill (2 fault-free reference, 2 cold
     # pre-crash, 2 replayed post-restart — the crashed incarnation's 2
-    # open chains are the postmortem, not outcomes) — the warm round's
-    # full-hit requests (no prefill span at all) must still close their
-    # serve.request chains typed
+    # open chains are the postmortem, not outcomes) + 8 from the
+    # post-decode stage drill (3 clean full-pipeline + 3 absorbing
+    # transient stage faults within the retry budget, plus the two
+    # exhaustion drills landing TYPED DEGRADED: tokens-only and
+    # unranked; DESIGN §8.5) — the warm round's full-hit requests (no
+    # prefill span at all) must still close their serve.request chains
+    # typed
     assert summary["request_outcomes"] == {
-        "completed": 30, "deadline_exceeded": 1,
+        "completed": 36, "deadline_exceeded": 1,
+        "completed_tokens_only": 1, "completed_unranked": 1,
     }
     assert summary["prefill_chunk_spans"] >= 2
     assert summary["spec_verify_spans"] >= 1

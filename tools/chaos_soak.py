@@ -74,6 +74,7 @@ SCHEDULED_SITES = (
     "page_exhaust", "prefill_fail", "decode_stall", "request_cancel",
     "replica_crash", "replica_stall", "health_flap",
     "prefix_hash_collide", "prefix_publish_fail", "replica_respawn_fail",
+    "vae_decode_fail", "rerank_fail", "stage_timeout",
 )
 # restart-time sites: armed just before a journal/snapshot load
 RESTART_SITES = ("journal_torn", "snapshot_corrupt")
@@ -90,9 +91,10 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
         RequestJournal, Router, RouterConfig, replay_unfinished,
     )
     from dalle_pytorch_tpu.utils.faults import FAULTS
-    from serve_smoke import build_tiny_model
+    from serve_smoke import build_tiny_model, build_tiny_stages
 
     dalle, params = build_tiny_model()
+    stages = build_tiny_stages()
     rng = np.random.RandomState(seed)
     prompts = [
         rng.randint(1, 16, size=(4,)).astype(np.int32) for _ in range(n_req)
@@ -108,16 +110,15 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
         for i in range(n_req)
     ]
 
-    # fault-free reference: the bit-parity oracle for every survivor
+    # fault-free reference: the bit-parity oracle for every survivor —
+    # tokens AND decoded images (the post-decode stages run here too)
     ref_engine = Engine(
-        dalle, params, EngineConfig(max_batch=2, prefill_chunk=2)
+        dalle, params, EngineConfig(max_batch=2, prefill_chunk=2),
+        stages=stages,
     )
     for req in requests:
         assert ref_engine.submit(req) is None
-    reference = {
-        rid: np.asarray(res.tokens)
-        for rid, res in ref_engine.run(max_steps=20_000).items()
-    }
+    reference = ref_engine.run(max_steps=20_000)
 
     tmp = tempfile.mkdtemp(prefix="chaos_soak_")
     jpath = os.path.join(tmp, "journal.jsonl")
@@ -139,7 +140,7 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
     def build_router() -> Router:
         return Router(
             dalle, params, router_cfg, engine_cfg, clock=clock,
-            journal=RequestJournal(jpath),
+            journal=RequestJournal(jpath), stages=stages,
         )
 
     FAULTS.reset()
@@ -160,6 +161,7 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
     restarts = 0
     snapshots = 0
     torn_total = 0
+    staged_resumes = 0
     next_req = 0
 
     def logical(rid: str) -> str:
@@ -240,9 +242,12 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
 
     def restart():
         """Process death: abandon the router mid-flight, rebuild, load
-        the snapshot (verify-on-load), replay the journal, resubmit
-        anything a torn tail dropped (the client-retry contract)."""
-        nonlocal router, restarts, torn_total
+        the snapshot (verify-on-load), replay the journal — requests
+        with a stage-boundary record resume from their LAST COMPLETED
+        stage (a journaled image skips VAE entirely; §8.5) — and
+        resubmit anything a torn tail dropped (the client-retry
+        contract)."""
+        nonlocal router, restarts, torn_total, staged_resumes
         restarts += 1
         router._journal.close()  # what a dead process leaves behind
         if rng.random() < 0.5:
@@ -261,8 +266,15 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
                 if not r.engine.load_prefix_snapshot(snapdir):
                     break  # rejected (corrupt/uncommitted): cold fleet
         torn0 = FAULTS.fired.get("journal_torn", 0)
+
+        def submit_staged(request, tokens, image=None):
+            nonlocal staged_resumes
+            staged_resumes += 1
+            return router.submit_staged(request, tokens, image=image)
+
         replayed = set(replay_unfinished(
-            jpath, router.submit, now=clock.now()
+            jpath, router.submit, now=clock.now(),
+            submit_staged=submit_staged,
         ))
         torn_total += FAULTS.fired.get("journal_torn", 0) - torn0
         # resubmit what the journal lost (torn tail): the client retry
@@ -388,9 +400,23 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
     for rid in sorted(submitted):
         res = delivered[rid]
         outcomes[res.outcome.value] = outcomes.get(res.outcome.value, 0) + 1
-        if res.outcome is Outcome.COMPLETED and not np.array_equal(
-            np.asarray(res.tokens), reference[rid]
+        ref = reference[rid]
+        # survivor bit-parity: tokens for every token-bearing outcome;
+        # the decoded image too wherever the pipeline produced one
+        # (COMPLETED and the typed-degraded completed_unranked) — the
+        # (seed, position) replay contract extended through the stages
+        if res.outcome in (
+            Outcome.COMPLETED, Outcome.COMPLETED_TOKENS_ONLY,
+            Outcome.COMPLETED_UNRANKED,
+        ) and not np.array_equal(np.asarray(res.tokens),
+                                 np.asarray(ref.tokens)):
+            mismatches.append(rid)
+        elif res.image is not None and not np.array_equal(
+            res.image, ref.image
         ):
+            mismatches.append(rid)
+        elif (res.outcome is Outcome.COMPLETED
+              and res.rerank_score != ref.rerank_score):
             mismatches.append(rid)
     completed = outcomes.get("completed", 0)
     ok = not mismatches and completed >= 1 and len(delivered) >= len(submitted)
@@ -411,7 +437,176 @@ def run_soak(iters: int, seed: int, n_replicas: int, n_req: int,
         "restarts": restarts,
         "snapshots_saved": snapshots,
         "journal_torn_dropped": torn_total,
+        "staged_resumes": staged_resumes,
         "replica_states": router.replica_states(),
+    }
+
+
+def run_stage_restart_drill(seed: int = 0) -> dict:
+    """Deterministic mid-stage kill/replay drill (docs/DESIGN.md §8.5):
+    the process dies with one request parked mid-VAE_DECODE and another
+    parked mid-CLIP_RERANK (its decoded image already journaled). The
+    restarted fleet must resume EACH from its last journaled stage
+    boundary — the mid-rerank request must NOT re-run the VAE (exactly
+    one VAE dispatch row in the new incarnation), both must finish
+    COMPLETED, and tokens/image/score must be bitwise-identical to a
+    fault-free reference run.
+
+    Parking is made deterministic with a long-backoff retry policy (one
+    armed stage fault -> the item waits ~100 virtual seconds before its
+    next attempt, far longer than the drill runs before "crashing")."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, FakeClock, Outcome, Request, RequestJournal,
+        Router, RouterConfig, replay_unfinished,
+    )
+    from dalle_pytorch_tpu.serving.postdecode import (
+        STAGE_RERANK, STAGE_VAE, StageConfig,
+    )
+    from dalle_pytorch_tpu.utils.faults import FAULTS
+    from dalle_pytorch_tpu.utils.metrics import counters
+    from dalle_pytorch_tpu.utils.resilience import RetryPolicy
+    from serve_smoke import build_tiny_model, build_tiny_stages
+
+    dalle, params = build_tiny_model()
+    parked_cfg = StageConfig(retry=RetryPolicy(
+        attempts=5, base_delay=100.0, max_delay=100.0, jitter=0.0,
+        retry_on=(),
+    ))
+    stages = build_tiny_stages(config=parked_cfg)
+
+    rng = np.random.RandomState(seed)
+    reqs = [
+        Request(
+            request_id=f"mid{i}",
+            prompt=rng.randint(1, 16, size=(4,)).astype(np.int32),
+            max_new_tokens=dalle.image_seq_len, seed=77 + i,
+        )
+        for i in range(2)
+    ]
+
+    # fault-free reference (default stage config — retry timing cannot
+    # change stage values, only when they are produced)
+    ref_engine = Engine(
+        dalle, params, EngineConfig(max_batch=2, prefill_chunk=2),
+        stages=build_tiny_stages(),
+    )
+    for req in reqs:
+        assert ref_engine.submit(req) is None
+    reference = ref_engine.run(max_steps=20_000)
+    assert all(
+        reference[r.request_id].outcome is Outcome.COMPLETED for r in reqs
+    )
+
+    tmp = tempfile.mkdtemp(prefix="stage_restart_")
+    jpath = os.path.join(tmp, "journal.jsonl")
+    clock = FakeClock(step_dt=0.05)
+    engine_cfg = EngineConfig(max_batch=2, prefill_chunk=2)
+    router_cfg = RouterConfig(n_replicas=1, respawn=False)
+
+    def build() -> Router:
+        return Router(
+            dalle, params, router_cfg, engine_cfg, clock=clock,
+            journal=RequestJournal(jpath), stages=stages,
+        )
+
+    FAULTS.reset()
+    router = build()
+
+    def parked(rid: str, stage: str):
+        pd = router._replicas[0].engine.postdecode
+        for st in pd._staged:
+            if (st.entry.request.request_id == rid and st.stage == stage
+                    and st.attempts > 0):
+                return st
+        return None
+
+    # 1) mid1: tokens -> VAE ok (image journaled) -> first rerank
+    #    dispatch fails -> parked mid-CLIP_RERANK on the long backoff
+    FAULTS.arm("rerank_fail", 1)
+    assert router.submit(reqs[1]) is None
+    for _ in range(1500):
+        router.step()
+        if parked("mid1", STAGE_RERANK) is not None:
+            break
+    st1 = parked("mid1", STAGE_RERANK)
+    assert st1 is not None and st1.image is not None, (
+        "mid1 never parked mid-rerank with a decoded image"
+    )
+
+    # 2) mid0: tokens -> first VAE dispatch fails -> parked mid-VAE
+    FAULTS.arm("vae_decode_fail", 1)
+    assert router.submit(reqs[0]) is None
+    for _ in range(1500):
+        router.step()
+        if parked("mid0", STAGE_VAE) is not None:
+            break
+    st0 = parked("mid0", STAGE_VAE)
+    assert st0 is not None and st0.image is None, (
+        "mid0 never parked mid-vae"
+    )
+    assert parked("mid1", STAGE_RERANK) is not None, (
+        "mid1 escaped its backoff before the crash"
+    )
+
+    # 3) the process dies with both parked mid-stage
+    router._journal.close()
+    labels = {"replica": "0"}
+    vae0 = counters.get("serve.stage.vae_images", labels=labels)
+    rr0 = counters.get("serve.stage.reranked", labels=labels)
+
+    # 4) restart: journal replay resumes each from its last completed
+    #    stage — mid0 pre-VAE (no image), mid1 post-VAE (image in hand)
+    router = build()
+    resumes: dict = {}
+
+    def submit_staged(request, tokens, image=None):
+        resumes[request.request_id] = image
+        return router.submit_staged(request, tokens, image=image)
+
+    replayed = set(replay_unfinished(
+        jpath, router.submit, now=clock.now(), submit_staged=submit_staged,
+    ))
+    assert replayed == {"mid0", "mid1"}, replayed
+    assert set(resumes) == {"mid0", "mid1"}, resumes
+    assert resumes["mid0"] is None, "mid0 resumed WITH an image pre-VAE"
+    assert resumes["mid1"] is not None, "mid1 lost its journaled image"
+
+    for _ in range(1500):
+        router.step()
+        if all(r.request_id in router.results for r in reqs):
+            break
+    router.verify_invariants()
+
+    vae_delta = counters.get("serve.stage.vae_images", labels=labels) - vae0
+    rr_delta = counters.get("serve.stage.reranked", labels=labels) - rr0
+    assert vae_delta == 1, (
+        f"expected exactly one VAE row after restart (mid0 only; mid1 "
+        f"resumes past VAE), got {vae_delta}"
+    )
+    assert rr_delta == 2, f"expected both requests reranked, got {rr_delta}"
+
+    for req in reqs:
+        res = router.results[req.request_id]
+        ref = reference[req.request_id]
+        assert res.outcome is Outcome.COMPLETED, (
+            f"{req.request_id}: {res.outcome}"
+        )
+        assert np.array_equal(
+            np.asarray(res.tokens), np.asarray(ref.tokens)
+        ), f"{req.request_id}: tokens diverge after mid-stage restart"
+        assert np.array_equal(res.image, ref.image), (
+            f"{req.request_id}: image not bit-identical after restart"
+        )
+        assert res.rerank_score == ref.rerank_score, (
+            f"{req.request_id}: rerank score diverged"
+        )
+    return {
+        "ok": True,
+        "staged_resumes": sorted(resumes),
+        "vae_rows_after_restart": int(vae_delta),
+        "reranked_after_restart": int(rr_delta),
     }
 
 
@@ -442,6 +637,10 @@ def main(argv=None) -> int:
 
     if lint_preflight(label="chaos soak") != 0:
         return 1
+
+    drill = run_stage_restart_drill(seed=args.seed)
+    print("stage restart drill:", json.dumps(drill, sort_keys=True),
+          file=sys.stderr)
 
     summary = run_soak(
         iters=args.iters, seed=args.seed, n_replicas=args.replicas,

@@ -48,6 +48,23 @@ CANON_MODEL = dict(
 # the canonical engine: chunked prefill on, the production serving shape
 CANON_ENGINE = dict(max_batch=2, prefill_chunk=2)
 
+# the canonical post-decode stage models (serving/postdecode.py): a VAE
+# whose token space and image_seq_len MATCH the canonical DALLE
+# (num_tokens == num_image_tokens, fmap == image_fmap_size, so the
+# engine's generated ids are valid decode input), and a CLIP sized to
+# the canonical text vocab/seq — the same tiny pair the serve-smoke
+# stage drill and the stage bench build
+CANON_VAE = dict(
+    image_size=4, num_layers=1, num_tokens=12, codebook_dim=16,
+    hidden_dim=8,
+)
+CANON_CLIP = dict(
+    dim_text=16, dim_image=16, dim_latent=16, num_text_tokens=16,
+    text_enc_depth=1, text_seq_len=4, text_heads=2, text_dim_head=8,
+    num_visual_tokens=12, visual_enc_depth=1, visual_heads=2,
+    visual_dim_head=8, visual_image_size=4, visual_patch_size=2,
+)
+
 
 def build_entry_points() -> List[EntryPoint]:
     import os
@@ -623,6 +640,7 @@ def build_entry_points() -> List[EntryPoint]:
                 (cache1_q, cacheB_q_arena, copy_vec, copy_vec, copy_vec),
             )],
         ),
+        *_stage_entries(),
         _train_entry(dalle, B),
         _block_sparse_entry(dalle, T),
         EntryPoint(
@@ -639,6 +657,74 @@ def build_entry_points() -> List[EntryPoint]:
         ),
     ]
     return entries
+
+
+def _stage_entries() -> List[EntryPoint]:
+    """The post-decode stage jits (serving/postdecode.py, DESIGN.md §8.5):
+    batched fixed-shape VAE decode and CLIP rerank. The pipeline pads
+    every dispatch to its configured batch width (StageConfig.batch ==
+    the canonical engine's max_batch), so each jit has EXACTLY one
+    steady signature — a second signature is the shape-drift-recompile
+    bug class, and the in-bench zero-in-trace-compile assertion
+    (bench.py --serve, stage record) holds only because of it. VAE
+    params are the decode-scope tree (``init(..., method="decode")``):
+    the pipeline's contract is token ids -> pixels, so the encoder
+    never rides along. No donation: stage tensors are tiny relative to
+    the KV pools, and the image must survive the dispatch (it is the
+    journal payload and the degraded-completion partial)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.models.clip import CLIP
+    from dalle_pytorch_tpu.models.vae import DiscreteVAE
+    from dalle_pytorch_tpu.serving import postdecode as pd
+
+    SDS = jax.ShapeDtypeStruct
+    S = CANON_ENGINE["max_batch"]  # == StageConfig default batch
+    vae = DiscreteVAE(**CANON_VAE)
+    clip = CLIP(**CANON_CLIP)
+    img_seq = SDS((1, vae.image_seq_len), jnp.int32)
+    vae_params = jax.eval_shape(
+        lambda i: vae.init(jax.random.key(0), i, method="decode"), img_seq
+    )["params"]
+    text1 = SDS((1, clip.text_seq_len), jnp.int32)
+    pix1 = SDS((1, vae.image_size, vae.image_size, vae.channels),
+               jnp.float32)
+    clip_params = jax.eval_shape(
+        lambda t, i: clip.init(jax.random.key(0), t, i), text1, pix1
+    )["params"]
+    return [
+        EntryPoint(
+            name="serving.vae_decode",
+            path="dalle_pytorch_tpu/serving/postdecode.py",
+            symbol="_vae_decode_jit",
+            fn=pd._vae_decode_jit,
+            lower=pd._vae_decode_jit.lower,
+            static_argnums=(0,),
+            donate={},
+            signatures=[Signature(
+                "steady",
+                (vae, vae_params, SDS((S, vae.image_seq_len), jnp.int32)),
+            )],
+        ),
+        EntryPoint(
+            name="serving.clip_rerank",
+            path="dalle_pytorch_tpu/serving/postdecode.py",
+            symbol="_clip_rerank_jit",
+            fn=pd._clip_rerank_jit,
+            lower=pd._clip_rerank_jit.lower,
+            static_argnums=(0,),
+            donate={},
+            # images arrive at the VAE's output size; the in-trace
+            # bilinear resize to the CLIP patch grid is data, not shape
+            signatures=[Signature(
+                "steady",
+                (clip, clip_params, SDS((S, clip.text_seq_len), jnp.int32),
+                 SDS((S, vae.image_size, vae.image_size, vae.channels),
+                     jnp.float32)),
+            )],
+        ),
+    ]
 
 
 def _block_sparse_entry(dalle, T: int) -> EntryPoint:

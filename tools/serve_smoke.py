@@ -27,6 +27,14 @@ a release pipeline runs before shipping a serving build::
 
     python tools/serve_smoke.py
 
+A post-decode STAGE drill (docs/DESIGN.md §8.5) additionally drives the
+tokens -> VAE decode -> CLIP rerank pipeline: clean completions with
+images bit-identical to a direct VAE decode, transient stage faults
+(``vae_decode_fail``/``rerank_fail``/``stage_timeout``) absorbed by
+retry with unchanged bits, and retry exhaustion completing
+typed-degraded (``completed_tokens_only`` / ``completed_unranked``) —
+never stalled.
+
 Composes with the fault registry for pipeline fault drills. The chunked
 pass runs FIRST, so an armed ``prefill_fail`` fires at CHUNK granularity
 and the retry must resume from the last completed chunk; an armed
@@ -118,6 +126,163 @@ def build_tiny_model():
     image = rng.randint(0, 12, size=(1, 4)).astype(np.int32)
     params = dalle.init(jax.random.key(0), text, image)["params"]
     return dalle, params
+
+
+def build_tiny_stages(config=None):
+    """A ``StageSpec`` over the CANONICAL tiny VAE + CLIP — the same
+    configs the trace-contract registry pins for ``serving.vae_decode``
+    / ``serving.clip_rerank`` (tools/lint/trace/registry.py), so every
+    gate that builds stages through this helper (this drill,
+    tools/chaos_soak.py, bench.py --serve, the unit tests) dispatches
+    the exact contracted signatures. VAE params are the decode-scope
+    tree (``init(..., method="decode")``): the pipeline's contract is
+    token ids -> pixels."""
+    import jax
+    import numpy as np
+
+    from dalle_pytorch_tpu.models.clip import CLIP
+    from dalle_pytorch_tpu.models.vae import DiscreteVAE
+    from dalle_pytorch_tpu.serving import StageSpec
+
+    if str(REPO / "tools") not in sys.path:
+        sys.path.insert(0, str(REPO / "tools"))
+    from lint.trace.registry import CANON_CLIP, CANON_VAE
+
+    vae = DiscreteVAE(**CANON_VAE)
+    vae_params = vae.init(
+        jax.random.key(1), np.zeros((1, vae.image_seq_len), np.int32),
+        method="decode",
+    )["params"]
+    clip = CLIP(**CANON_CLIP)
+    clip_params = clip.init(
+        jax.random.key(2), np.ones((1, clip.text_seq_len), np.int32),
+        np.zeros((1, vae.image_size, vae.image_size, vae.channels),
+                 np.float32),
+    )["params"]
+    kw = {} if config is None else {"config": config}
+    return StageSpec(vae=vae, vae_params=vae_params, clip=clip,
+                     clip_params=clip_params, **kw)
+
+
+def run_stage_drill(dalle, params) -> bool:
+    """The post-decode pipeline gate (docs/DESIGN.md §8.5): four passes
+    over a staged engine on FakeClock (deterministic backoff windows).
+
+    1. CLEAN: 3 requests complete the full tokens -> VAE -> rerank
+       pipeline; every image must be BIT-identical to a direct
+       ``vae.apply(method="decode")`` of the request's own tokens.
+    2. TRANSIENT faults: ``vae_decode_fail=2`` + ``rerank_fail=1`` +
+       ``stage_timeout=1`` armed — all within the retry budget, so all
+       3 requests still COMPLETE with tokens AND images bit-identical
+       to the clean pass, with the retries counted.
+    3. VAE retry EXHAUSTION (one request, 3 armed failures): the
+       request completes typed-degraded ``completed_tokens_only``.
+    4. RERANK exhaustion: typed-degraded ``completed_unranked`` — the
+       decoded image survives, bit-identical to the clean pass.
+
+    Env-composed drills (the DTL033 registry contract) ride the same
+    passes — counts <= 2 are absorbed by retry (pass 2's shape),
+    higher counts surface as typed-degraded outcomes, never stalls::
+
+        DALLE_TPU_FAULTS="vae_decode_fail=2" python tools/serve_smoke.py
+        DALLE_TPU_FAULTS="rerank_fail=1,stage_timeout=1" python tools/serve_smoke.py
+    """
+    import numpy as np
+
+    from dalle_pytorch_tpu.serving import (
+        Engine, EngineConfig, FakeClock, Outcome, Request,
+    )
+    from dalle_pytorch_tpu.utils.faults import FAULTS
+    from dalle_pytorch_tpu.utils.metrics import counters
+
+    spec = build_tiny_stages()
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(1, 16, size=(4,)).astype(np.int32)
+               for _ in range(3)]
+
+    def run_pass(label, n_req, arm=()):
+        eng = Engine(
+            dalle, params, EngineConfig(max_batch=2, prefill_chunk=2),
+            stages=spec, clock=FakeClock(step_dt=0.05),
+        )
+        for site, count in arm:
+            FAULTS.arm(site, count)
+        for i in range(n_req):
+            assert eng.submit(Request(
+                request_id=f"stage{i}", prompt=prompts[i],
+                max_new_tokens=dalle.image_seq_len, seed=40 + i,
+            )) is None
+        results = eng.run(max_steps=4000)
+        eng.verify_invariants(idle=True)
+        for rid in sorted(results):
+            print(json.dumps({"pass": label, **results[rid].to_json()}))
+        print(json.dumps({"pass": label, "stats": eng.stats()}))
+        return results
+
+    ok = True
+    clean = run_pass("stage_clean", 3)
+    for rid, res in clean.items():
+        if res.outcome is not Outcome.COMPLETED or res.image is None \
+                or res.rerank_score is None:
+            ok = False
+            print(f"serve smoke FAILED: stage clean {rid} not fully "
+                  f"completed ({res.outcome.value})", file=sys.stderr)
+            continue
+        direct = np.asarray(spec.vae.apply(
+            {"params": spec.vae_params},
+            np.asarray(res.tokens, np.int32)[None, :], method="decode",
+        ))[0].astype(np.float32)
+        if not np.array_equal(direct, res.image):
+            ok = False
+            print(f"serve smoke FAILED: stage clean {rid} image diverges "
+                  "from a direct VAE decode of its own tokens",
+                  file=sys.stderr)
+
+    retries0 = counters.get("serve.stage.retries")
+    faulted = run_pass("stage_faults", 3, arm=(
+        ("vae_decode_fail", 2), ("rerank_fail", 1), ("stage_timeout", 1),
+    ))
+    if counters.get("serve.stage.retries") <= retries0:
+        ok = False
+        print("serve smoke FAILED: stage fault pass consumed no retries",
+              file=sys.stderr)
+    for rid, res in faulted.items():
+        if res.outcome is not Outcome.COMPLETED:
+            ok = False
+            print(f"serve smoke FAILED: {rid} did not absorb transient "
+                  f"stage faults ({res.outcome.value})", file=sys.stderr)
+        elif not (np.array_equal(np.asarray(res.tokens),
+                                 np.asarray(clean[rid].tokens))
+                  and np.array_equal(res.image, clean[rid].image)):
+            ok = False
+            print(f"serve smoke FAILED: {rid} tokens/image diverged across "
+                  "stage retries", file=sys.stderr)
+
+    # exhaustion passes: every armed count == the retry budget, so the
+    # arms are fully consumed in-pass (no reset — env-armed sites for
+    # later passes stay intact)
+    attempts = spec.config.retry.attempts
+    tokens_only = run_pass("stage_degrade_vae", 1,
+                           arm=(("vae_decode_fail", attempts),))
+    res = tokens_only["stage0"]
+    if res.outcome is not Outcome.COMPLETED_TOKENS_ONLY \
+            or res.tokens is None or res.image is not None:
+        ok = False
+        print("serve smoke FAILED: VAE exhaustion did not degrade to "
+              f"completed_tokens_only ({res.outcome.value})", file=sys.stderr)
+    unranked = run_pass("stage_degrade_rerank", 1,
+                        arm=(("rerank_fail", attempts),))
+    res = unranked["stage0"]
+    if res.outcome is not Outcome.COMPLETED_UNRANKED or res.image is None \
+            or res.rerank_score is not None:
+        ok = False
+        print("serve smoke FAILED: rerank exhaustion did not degrade to "
+              f"completed_unranked ({res.outcome.value})", file=sys.stderr)
+    elif not np.array_equal(res.image, clean["stage0"].image):
+        ok = False
+        print("serve smoke FAILED: completed_unranked image diverges from "
+              "the clean pass", file=sys.stderr)
+    return ok
 
 
 def run_replicated_drill(dalle, params, n_replicas: int,
@@ -584,6 +749,11 @@ def _run_passes(n_replicas: int, preempt) -> int:
     # bit-identical replay and a warm restored cache
     ok = run_recovery_drill(dalle, params, preempt) and ok
 
+    # post-decode stage pipeline (docs/DESIGN.md §8.5): full
+    # tokens->VAE->rerank completion with bit-identical images, transient
+    # stage faults absorbed by retry, exhaustion typed-degraded
+    ok = run_stage_drill(dalle, params) and ok
+
     if n_replicas:
         ok = run_replicated_drill(
             dalle, params, n_replicas, preempt=preempt
@@ -599,7 +769,8 @@ def _run_passes(n_replicas: int, preempt) -> int:
           "cold/warm replay (bit-identical, warm round "
           "hit the index), mid-prefill deadline drill typed, pool drained, "
           "kill-restore-replay recovery drill bit-identical with a warm "
-          "restored cache"
+          "restored cache, POST-DECODE stage drill (bit-identical images, "
+          "transient stage faults absorbed, exhaustion typed-degraded)"
           + (f", {2 * n_replicas}/{2 * n_replicas} completed the "
              f"{n_replicas}-replica crash drill bit-identically"
              if n_replicas else ""),

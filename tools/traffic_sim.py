@@ -118,6 +118,11 @@ class IterationCostModel:
     constant: bool = False
     fixed_s: float = 0.0
     tick_idle: bool = False
+    # post-decode stage rows (serving/postdecode.py, DESIGN §8.5): the
+    # per-image VAE decode / CLIP rerank cost charged on top of token
+    # work. 0.0 = the stage model contributes no virtual time.
+    vae_ms_per_image: float = 0.0
+    rerank_ms_per_image: float = 0.0
 
     def cost_s(self, decode_tokens: int, prefill_tokens: int,
                rng: Optional[random.Random]) -> float:
@@ -131,6 +136,22 @@ class IterationCostModel:
             + self.prefill_ms_per_token * prefill_tokens
         )
         if self.jitter_frac > 0.0 and rng is not None:
+            ms *= math.exp(rng.gauss(0.0, self.jitter_frac))
+        return ms / 1e3
+
+    def stage_cost_s(self, vae_images: int, reranked: int,
+                     rng: Optional[random.Random]) -> float:
+        """Virtual cost of this iteration's post-decode stage rows,
+        charged on top of token work (zero under the fidelity-matched
+        constant clock — its fixed per-iteration tick already covers
+        everything the engine did)."""
+        if self.constant or (vae_images == 0 and reranked == 0):
+            return 0.0
+        ms = (
+            self.vae_ms_per_image * vae_images
+            + self.rerank_ms_per_image * reranked
+        )
+        if ms > 0.0 and self.jitter_frac > 0.0 and rng is not None:
             ms *= math.exp(rng.gauss(0.0, self.jitter_frac))
         return ms / 1e3
 
@@ -175,6 +196,15 @@ class StubEngineConfig:
     # hit shares the template's prompt pages (charged to __prefix__) and
     # skips prefill entirely — the TTFT / hit-rate / arena-share lever.
     prefix_templates: int = 0
+    # post-decode stage model (serving/postdecode.py semantics): tokens-
+    # complete requests pass VAE_DECODE -> [CLIP_RERANK] -> DONE under a
+    # per-iteration stage budget, with enqueue-time pressure degradation
+    # to the typed COMPLETED_TOKENS_ONLY outcome.
+    stages: bool = False
+    stage_budget: int = 2              # stage rows per iteration
+    stage_queue_limit: int = 64        # staged backlog -> degrade at entry
+    stage_high_watermark: float = 1.0  # occupancy past this -> degrade
+    stage_rerank: bool = True
 
 
 class StubEngine:
@@ -233,6 +263,12 @@ class StubEngine:
         # per-slot prefill progress / decode tally, keyed by request_id
         self._prompt_left: Dict[str, int] = {}
         self._gen: Dict[str, int] = {}
+        # post-decode stage queue (config.stages): tokens-complete
+        # entries parked for VAE/rerank rows; they stay LIVE but hold
+        # no slot or pages — serving/postdecode.py semantics
+        self._staged: List[Entry] = []
+        self._stage: Dict[str, str] = {}       # rid -> vae_decode|clip_rerank
+        self._stage_hit: Dict[str, Optional[str]] = {}
         # prefix-template LRU: key -> [pages, refcount]
         self._templates: "OrderedDict[bytes, list]" = OrderedDict()
 
@@ -287,13 +323,17 @@ class StubEngine:
         self._sweep_terminations()
         self._admit()
         decode_tokens, prefill_tokens = self._advance()
-        worked = bool(decode_tokens or prefill_tokens)
+        vae_rows, rerank_rows = self._stage_advance()
+        worked = bool(
+            decode_tokens or prefill_tokens or vae_rows or rerank_rows
+        )
         if worked:
             self.iterations += 1
         dt = self._cost.cost_s(decode_tokens, prefill_tokens, self._rng)
+        dt += self._cost.stage_cost_s(vae_rows, rerank_rows, self._rng)
         if dt > 0:
             self.clock.advance(dt)
-        return worked or bool(self.sched) or any(
+        return worked or bool(self.sched) or bool(self._staged) or any(
             s is not None for s in self.slots
         )
 
@@ -305,19 +345,27 @@ class StubEngine:
                 key=lambda e: e.seq,
             )
         ]
-        return queued + running
+        staged = [
+            e.request for e in sorted(self._staged, key=lambda e: e.seq)
+        ]
+        return queued + running + staged
 
     def verify_invariants(self, idle: bool = False) -> None:
         slot_ids = {
             s.request_id for s in self.slots if s is not None
         }
         queued_ids = self.sched.ids()
+        staged_ids = {e.request_id for e in self._staged}
         assert not (slot_ids & queued_ids), (
             f"running AND queued: {sorted(slot_ids & queued_ids)}"
         )
-        assert self._live == slot_ids | queued_ids, (
+        assert not (staged_ids & (slot_ids | queued_ids)), (
+            f"staged AND running/queued: "
+            f"{sorted(staged_ids & (slot_ids | queued_ids))}"
+        )
+        assert self._live == slot_ids | queued_ids | staged_ids, (
             f"live {len(self._live)} != slots {len(slot_ids)} + "
-            f"queued {len(queued_ids)}"
+            f"queued {len(queued_ids)} + staged {len(staged_ids)}"
         )
         assert len(self.results) + len(self._live) == self._submitted, (
             f"{self._submitted} submitted, {len(self.results)} results, "
@@ -547,6 +595,13 @@ class StubEngine:
                     )
                     if entry is not None:
                         self._release_slot(entry)
+                if entry is None:
+                    entry = next(
+                        (e for e in self._staged if e.request_id == rid),
+                        None,
+                    )
+                    if entry is not None:
+                        self._stage_remove(entry)
                 if entry is not None:
                     self._terminal(entry, Outcome.CANCELLED)
                 self._cancel_requested.discard(rid)
@@ -561,12 +616,93 @@ class StubEngine:
                 self._release_slot(entry)
                 self._terminal(entry, Outcome.DEADLINE_EXCEEDED,
                                detail="deadline passed mid-flight")
+        for entry in list(self._staged):
+            d = entry.request.deadline
+            if d is not None and now > d:
+                self._stage_remove(entry)
+                self._terminal(entry, Outcome.DEADLINE_EXCEEDED,
+                               detail="deadline passed mid-stage")
 
     def _finish(self, entry: Entry, outcome: Outcome) -> None:
         hit = entry.hit_class          # cleared by _release_slot
         self._release_slot(entry)
+        if outcome is Outcome.COMPLETED and self.config.stages:
+            self._stage_enqueue(entry, hit)
+            return
         self.counters.inc("serve.completed")
         self._terminal(entry, outcome,
+                       detail=f"prefix_hit:{hit}" if hit else "")
+
+    # -- post-decode stage model (config.stages) ---------------------
+
+    def _stage_enqueue(self, entry: Entry, hit: Optional[str]) -> None:
+        """Tokens-complete entry enters the modeled pipeline. Pressure
+        degradation happens HERE, at the stage boundary, exactly like
+        the real pipeline: a typed COMPLETED_TOKENS_ONLY instead of an
+        unbounded stage backlog."""
+        cfg = self.config
+        self.counters.inc("serve.stage.enqueued")
+        occ = (
+            self._fleet_occupancy()
+            if self._fleet_occupancy is not None
+            else self.pool.occupancy
+        )
+        if len(self._staged) >= cfg.stage_queue_limit:
+            self.counters.inc("serve.stage.degraded")
+            self._terminal(entry, Outcome.COMPLETED_TOKENS_ONLY,
+                           detail="stage_backlog")
+            return
+        if occ > cfg.stage_high_watermark:
+            self.counters.inc("serve.stage.degraded")
+            self._terminal(entry, Outcome.COMPLETED_TOKENS_ONLY,
+                           detail="stage_watermark")
+            return
+        rid = entry.request_id
+        self._stage[rid] = "vae_decode"
+        self._stage_hit[rid] = hit
+        self._staged.append(entry)
+
+    def _stage_remove(self, entry: Entry) -> None:
+        self._staged.remove(entry)
+        self._stage.pop(entry.request_id, None)
+        self._stage_hit.pop(entry.request_id, None)
+
+    def _stage_advance(self) -> Tuple[int, int]:
+        """One iteration of budgeted stage rows, completion-priority
+        like the real pipeline (rerank-stage rows dispatch before fresh
+        VAE rows). Returns (vae_rows, rerank_rows) for the cost model."""
+        if not self._staged:
+            return 0, 0
+        budget = self.config.stage_budget
+        vae_rows = rerank_rows = 0
+        order = sorted(
+            self._staged,
+            key=lambda e: (self._stage[e.request_id] != "clip_rerank",
+                           e.seq),
+        )
+        for entry in order:
+            if budget <= 0:
+                break
+            budget -= 1
+            rid = entry.request_id
+            if self._stage[rid] == "clip_rerank":
+                rerank_rows += 1
+                self.counters.inc("serve.stage.reranked")
+                self._stage_complete(entry)
+            else:
+                vae_rows += 1
+                self.counters.inc("serve.stage.vae_images")
+                if self.config.stage_rerank:
+                    self._stage[rid] = "clip_rerank"
+                else:
+                    self._stage_complete(entry)
+        return vae_rows, rerank_rows
+
+    def _stage_complete(self, entry: Entry) -> None:
+        hit = self._stage_hit.get(entry.request_id)
+        self._stage_remove(entry)
+        self.counters.inc("serve.completed")
+        self._terminal(entry, Outcome.COMPLETED,
                        detail=f"prefix_hit:{hit}" if hit else "")
 
     def _terminal(self, entry: Entry, outcome: Outcome,
@@ -930,9 +1066,11 @@ def _lane_record(router, logicals, occ_trace, duration, iters,
     outcomes: Dict[str, int] = {}
     ttfts: List[float] = []
     lat: List[float] = []
+    img_lat: List[float] = []
     client_lat: List[float] = []
     hits = 0
     completed = 0
+    degraded = 0
     retries_total = 0
     shed = 0
     for lg in logicals:
@@ -940,12 +1078,21 @@ def _lane_record(router, logicals, occ_trace, duration, iters,
         assert res is not None, lg.base.request_id
         outcomes[res.outcome.value] = outcomes.get(res.outcome.value, 0) + 1
         retries_total += lg.retried
+        if res.outcome in (
+            Outcome.COMPLETED_TOKENS_ONLY, Outcome.COMPLETED_UNRANKED,
+        ):
+            # successes of the degradation policy: the request finished
+            # typed, it just shed post-decode work under pressure
+            degraded += 1
         if res.outcome is Outcome.COMPLETED:
             completed += 1
             if res.ttft_s is not None:
                 ttfts.append(res.ttft_s)
             if res.total_latency_s is not None:
                 lat.append(res.total_latency_s)
+                # with the stage model on, a COMPLETED entry's total
+                # latency IS submit -> image (stages precede DONE)
+                img_lat.append(res.total_latency_s)
             if lg.final_t is not None:
                 # client-perceived: arrival -> final, across every
                 # retry and the router queue — the SLO the frontier
@@ -966,12 +1113,22 @@ def _lane_record(router, logicals, occ_trace, duration, iters,
         "router_submitted": stats["submitted"],
         "outcomes": dict(sorted(outcomes.items())),
         "completed": completed,
-        "goodput_qps": (completed / duration) if duration > 0 else 0.0,
+        # goodput counts every TYPED successful finish — full
+        # completions plus the degradation policy's tokens-only/
+        # unranked outcomes (shedding stage work must not read as a
+        # goodput collapse; the cost of degrading shows in
+        # degraded_frac, not here)
+        "goodput_qps": (
+            (completed + degraded) / duration
+        ) if duration > 0 else 0.0,
         "shed_frac": shed / n if n else 0.0,
         "retries": retries_total,
         "ttft_p50_s": _percentile(ttfts, 0.50),
         "ttft_p99_s": _percentile(ttfts, 0.99),
         "latency_p99_s": _percentile(lat, 0.99),
+        "request_image_p50_s": _percentile(img_lat, 0.50),
+        "request_image_p99_s": _percentile(img_lat, 0.99),
+        "degraded_frac": degraded / n if n else 0.0,
         "client_latency_p50_s": _percentile(client_lat, 0.50),
         "client_latency_p99_s": _percentile(client_lat, 0.99),
         "prefix_hit_frac": (hits / completed) if completed else 0.0,
@@ -1014,6 +1171,11 @@ class FleetSpec:
     respawn_jitter: float = 0.0
     backoff_seed: int = 0
     stall_timeout_s: float = 30.0
+    # post-decode stage model knobs (StubEngineConfig passthrough)
+    stages: bool = False
+    stage_budget: int = 2
+    stage_queue_limit: int = 64
+    stage_high_watermark: float = 1.0
 
 
 def build_modeled_router(spec: FleetSpec, cost: IterationCostModel,
@@ -1032,6 +1194,10 @@ def build_modeled_router(spec: FleetSpec, cost: IterationCostModel,
         degraded_max_new_tokens=spec.degraded_max_new_tokens,
         prefill_chunk=spec.text_len,
         prefix_templates=spec.prefix_templates,
+        stages=spec.stages,
+        stage_budget=spec.stage_budget,
+        stage_queue_limit=spec.stage_queue_limit,
+        stage_high_watermark=spec.stage_high_watermark,
     )
     builds = [0]                        # respawn generations get new RNGs
 
@@ -1450,6 +1616,38 @@ def run_modeled(mode: str, seed: int) -> dict:
         kills=storm_kills, respawn_fails=1,
     )
 
+    # post-decode stage frontier (DESIGN §8.5): the same capacity sweep
+    # with per-image VAE/CLIP stage rows charged to the clock and the
+    # pipeline's pressure degradation armed (watermark 0.95), including
+    # a 2x-overload level that must finish TYPED — request->image p99
+    # and the degraded fraction are the columns this adds
+    stage_cost = replace(
+        cost, vae_ms_per_image=4.0, rerank_ms_per_image=2.0,
+    )
+    # stage_budget=1 caps the pipeline at one row (half a completion)
+    # per iteration while short token jobs finish >1 per iteration at
+    # saturation — overload overflows the small stage backlog and the
+    # policy must shed TYPED, not queue unboundedly
+    stage_spec = replace(
+        spec, stages=True, stage_budget=1, stage_queue_limit=8,
+        stage_high_watermark=0.95,
+    )
+    stage_base = replace(
+        base, n_requests=min(base.n_requests, 4_000),
+        max_new_lo=4, max_new_hi=8,
+    )
+    stage_frontier = run_frontier(
+        stage_spec, stage_base, policy,
+        [qps_levels[0], 2.0 * qps_levels[-1]], slo_p99_s=2.0,
+        cost=stage_cost, seed=seed + 3,
+    )
+    over = stage_frontier["levels"][-1]
+    assert over["degraded_frac"] > 0.0, (
+        "2x overload never tripped the stage degradation policy: "
+        f"{over}"
+    )
+    assert over["request_image_p99_s"] is not None, over
+
     rec = _mode_record(mode, seed)
     rec["fleet"] = {
         "n_replicas": spec.n_replicas,
@@ -1459,7 +1657,9 @@ def run_modeled(mode: str, seed: int) -> dict:
     }
     rec["frontier"] = frontier
     rec["storm"] = storm
+    rec["stage_frontier"] = stage_frontier
     n_total = _count_requests(frontier, storm)
+    n_total += _count_requests(stage_frontier, None)
     rec["totals"] = {
         "modeled_requests": n_total,
         "wall_s": round(time.monotonic() - t_wall, 3),
@@ -1470,6 +1670,7 @@ def run_modeled(mode: str, seed: int) -> dict:
         "goodput_bounded_past_saturation",
         "storm_amplification_guard",
         "respawn_ladder_desynchronized",
+        "stage_overload_degrades_typed",
     ]
     if mode == "sweep":
         # the grid rides on top: one frontier per arrival shape
