@@ -13,6 +13,11 @@ count cross-checks it (the run warns if they diverge >10%).
 Output: one JSON line per metric; the LAST line is the headline train-MFU
 metric. vs_baseline is against the driver's >=45%-MFU north-star target
 (BASELINE.json); the reference itself publishes no numbers (BASELINE.md).
+Every record carries a shared provenance stamp (git sha, jax/jaxlib
+versions, device kind, env flags, seed — ISSUE 19); ``--flagship`` runs
+the full serve matrix, the adaptive-control record, and the Pallas
+block-size sweep as one measurement session whose output becomes the next
+committed BENCH_r*.json trend point (tools/bench_trend.py --check).
 """
 
 import json
@@ -123,6 +128,73 @@ def _sig_delta(after: dict, before: dict) -> dict:
         k: (after[k] - before[k] if after[k] >= 0 and before[k] >= 0 else -1)
         for k in after
     }
+
+
+# ------------------------------------------------------ provenance stamp
+# A BENCH_r*.json tail is only a trend point if the reader can tell
+# which code, which jax, which device, and which knobs produced it
+# (ISSUE 19): every record main() emits goes through _emit, which stamps
+# one shared provenance block — git sha, jax/jaxlib versions, device
+# kind, the DALLE_TPU_*/JAX_* env flags in effect, and the record's own
+# seed — so tools/bench_trend.py comparisons are never apples-to-unknown.
+
+_PROVENANCE = None
+
+
+def provenance() -> dict:
+    """The cached per-process provenance block (computed once: the git
+    sha and device kind cannot change mid-run)."""
+    global _PROVENANCE
+    if _PROVENANCE is None:
+        import platform as _platform
+        import subprocess
+
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            ).stdout.strip() or None
+        except Exception:
+            sha = None
+        try:
+            import jaxlib
+            jaxlib_version = jaxlib.__version__
+        except Exception:
+            jaxlib_version = None
+        dev = jax.devices()[0]
+        _PROVENANCE = {
+            "git_sha": sha,
+            "jax_version": jax.__version__,
+            "jaxlib_version": jaxlib_version,
+            "platform": dev.platform,
+            "device_kind": dev.device_kind,
+            "n_devices": jax.device_count(),
+            "python": _platform.python_version(),
+            "env_flags": {
+                k: v for k, v in sorted(os.environ.items())
+                if k.startswith(("DALLE_TPU_", "JAX_", "XLA_FLAGS"))
+            },
+        }
+    return _PROVENANCE
+
+
+def _emit(record: dict) -> dict:
+    """Print one metric record as a JSON line with the provenance block
+    attached. The record's own fields always win; its seed (``seed`` or
+    ``arrival_seed``, whichever the section reports) is folded into the
+    stamp so replaying the exact run needs nothing beyond the record."""
+    out = dict(record)
+    prov = dict(provenance())
+    for key in ("arrival_seed", "seed"):
+        if key in out:
+            prov["seed"] = out[key]
+            break
+    out.setdefault("provenance", prov)
+    print(json.dumps(out))
+    return out
+
+
 NUM_TEXT, NUM_IMAGE = 10000, 8192
 BATCH = 8
 
@@ -2132,6 +2204,281 @@ def bench_serve_recovery(on_cpu: bool, seed: int = 0, int8: bool = True):
     }
 
 
+def bench_serve_control(on_cpu: bool, seed: int = 0):
+    """--serve / --flagship companion: the adaptive control loop measured
+    IN-BENCH (ISSUE 19). Two forced regimes drive the Controller's two
+    headline knob channels end to end through REAL engines, and the
+    record asserts the adaptation happened through zero-recompile
+    channels:
+
+      * spec channel — a depth-4 f32 model whose depth-1 early-exit
+        drafter genuinely misdrafts (~0.3 windowed accept rate, below
+        ``spec_accept_low``) runs controller-on vs controller-off over
+        one seeded trace. Asserted: the effective verify width steps
+        DOWN from the pre-traced ceiling; the post-warmup trace performs
+        ZERO backend compiles and ZERO serving-jit recompiles (the
+        width is descriptor DATA under the pre-traced signatures); and
+        completed tokens are BIT-identical controller-on vs -off
+        (exact-match acceptance absorbs any verify width — the
+        controller moves cost, never output).
+      * budget channel — the same geometry decodes on a virtual clock
+        whose per-iteration dt jumps 100x mid-trace: the deterministic
+        stand-in for interference (the traffic simulator's virtual-time
+        idiom; real-gap wall clock lives in bench_serve_interference).
+        Asserted: the TokenBudget holds at its default while gaps sit
+        under the SLO threshold, tightens toward the liveness floor
+        while they exceed it, relaxes back to the default once the
+        vitals window flushes, keeps the SAME chunk width throughout
+        (grant geometry never re-traces), and every request still
+        completes (the head-of-line floor).
+
+    The record's value is the spec channel's width drop; the cost-ledger
+    entries the vitals layer charged during warmup (lowered-module FLOPs
+    and bytes, no extra backend compile) ride along as fields."""
+    from dalle_pytorch_tpu.models import DALLE
+    from dalle_pytorch_tpu.serving import (
+        ControlConfig, Engine, EngineConfig, FakeClock, Outcome, Request,
+        check_accounting,
+    )
+
+    # misdrafting geometry (tests/test_control.py): small enough for any
+    # host, deep enough that the depth-1 drafter's accept rate sits well
+    # under the default spec_accept_low
+    dalle = DALLE(
+        dim=32, depth=4, num_text_tokens=32, text_seq_len=6,
+        num_image_tokens=64, image_fmap_size=4, heads=2, dim_head=8,
+        attn_types=("full",), shift_tokens=True, rotary_emb=True,
+    )
+    rng = np.random.RandomState(seed)
+    text = jnp.asarray(rng.randint(1, 32, size=(1, 6)), jnp.int32)
+    image = jnp.asarray(rng.randint(0, 64, size=(1, 16)), jnp.int32)
+    params = dalle.init(jax.random.key(0), text, image)["params"]
+
+    n_req, max_new, spec_k = 4, 10, 3
+    prompts = [
+        np.random.RandomState(seed * 7919 + 100 + i)
+        .randint(1, 32, size=(6,)).astype(np.int32)
+        for i in range(n_req)
+    ]
+
+    def submit_all(eng, max_new_tokens):
+        for i in range(n_req):
+            eng.submit(Request(
+                request_id=f"r{i}", prompt=prompts[i],
+                max_new_tokens=max_new_tokens, seed=seed * 31 + i,
+            ))
+
+    # ---- spec channel: forced-low accept, parity, zero recompiles ----
+    def spec_run(controller: bool):
+        eng = Engine(dalle, params, EngineConfig(
+            max_batch=2, prefill_chunk=2, fused_iteration=True,
+            spec_decode=True, spec_k=spec_k, spec_draft_depth=1,
+            controller=controller, cost_ledger=controller,
+            control=ControlConfig(interval=4) if controller else None,
+        ), clock=FakeClock(step_dt=1.0))
+        # warm both signature classes + slot indices (and, controller-on,
+        # charge the cost ledger) outside the measured trace
+        for i in range(2):
+            eng.submit(Request(
+                request_id=f"__warm{i}__",
+                prompt=np.zeros(6, np.int32), max_new_tokens=2, seed=0,
+            ))
+        eng.run(max_steps=400)
+        sig0, bc0 = serving_jit_signatures(), backend_compiles()
+        submit_all(eng, max_new)
+        eng.run(max_steps=800)
+        sig1, bc1 = serving_jit_signatures(), backend_compiles()
+        check_accounting(eng)
+        toks = {
+            r.request_id: np.asarray(r.tokens)
+            for r in eng.results.values()
+            if r.outcome is Outcome.COMPLETED
+            and not r.request_id.startswith("__warm")
+        }
+        assert len(toks) == n_req, (
+            f"{'controller-on' if controller else 'controller-off'} trace "
+            f"completed {len(toks)}/{n_req}"
+        )
+        return (
+            eng, toks,
+            bc1 - bc0 if bc0 >= 0 else -1, _sig_delta(sig1, sig0),
+        )
+
+    eng_on, toks_on, compiles_trace, sig_trace = spec_run(controller=True)
+    _, toks_off, _, _ = spec_run(controller=False)
+
+    assert eng_on._eff_spec_k < spec_k, (
+        f"controller never stepped spec_k down from {spec_k} under the "
+        f"misdrafter's forced-low accept rate"
+    )
+    reasons = [r for d in eng_on.controller.log for r in d.reasons]
+    assert "spec_down" in reasons
+    assert compiles_trace in (0, -1), (
+        f"adaptive trace compiled {compiles_trace} modules — a knob "
+        f"channel re-traced"
+    )
+    assert all(v in (0, -1) for v in sig_trace.values()), (
+        f"adaptive trace recompiled serving jits: {sig_trace}"
+    )
+    assert all(
+        np.array_equal(toks_on[rid], toks_off[rid]) for rid in toks_off
+    ), "controller-on tokens diverged from controller-off (f32 parity)"
+    spec_vitals = eng_on.vitals.snapshot()
+    ledger = eng_on.vitals.ledger.snapshot() if eng_on.vitals.ledger else {}
+
+    # ---- budget channel: virtual-time interference ----
+    cc = ControlConfig(interval=2, gap_high_s=0.5)
+    clock = FakeClock(step_dt=0.02)
+    eng = Engine(dalle, params, EngineConfig(
+        max_batch=2, prefill_chunk=2, fused_iteration=True,
+        controller=True, control=cc, vitals_window=4,
+    ), clock=clock)
+    budget_default, chunk = eng.budget.budget, eng.budget.chunk
+    floor = max(chunk, int(budget_default * cc.budget_min_frac))
+    submit_all(eng, 16)  # fmap^2 caps max_new at 16 on this geometry
+    steps = 0
+    while steps < 10 and eng.step():
+        steps += 1
+    assert eng.budget.budget == budget_default, (
+        "budget moved while every gap sat under the SLO threshold"
+    )
+    clock.step_dt = 2.0  # interference regime: every gap breaches the SLO
+    budget_min = budget_default
+    steps = 0
+    while steps < 10 and eng.step():
+        steps += 1
+        budget_min = min(budget_min, eng.budget.budget)
+    assert budget_min < budget_default, (
+        "budget never tightened under forced interference gaps"
+    )
+    clock.step_dt = 0.02  # interference clears; the vitals window flushes
+    recovered = False
+    while eng.step():
+        recovered = recovered or eng.budget.budget == budget_default
+    assert recovered, "budget never relaxed back after interference cleared"
+    assert eng.budget.chunk == chunk, (
+        "budget adaptation changed the grant chunk — that is a retrace "
+        "channel"
+    )
+    results = {
+        r.request_id: r for r in eng.results.values()
+    }
+    assert len(results) == n_req and all(
+        r.outcome is Outcome.COMPLETED for r in results.values()
+    ), "budget tightening starved a request (head-of-line floor broken)"
+    check_accounting(eng)
+
+    return {
+        "metric": "serve_control_spec_k_steps_down",
+        "value": float(spec_k - eng_on._eff_spec_k),
+        "unit": "verify_width_steps",
+        "vs_baseline": None,
+        "spec_k_ceiling": spec_k,
+        "spec_k_adapted": int(eng_on._eff_spec_k),
+        "windowed_accept_rate": round(spec_vitals["spec_accept_rate"], 4),
+        "decisions": len(eng_on.controller.log),
+        "adjustments": sum(d.changed for d in eng_on.controller.log),
+        "controller_on_tokens_bit_identical_to_off": True,  # asserted
+        "compiles_in_trace": compiles_trace,
+        "jit_recompiles_in_trace": sig_trace,
+        "cost_ledger": ledger,
+        "budget_default": budget_default,
+        "budget_min_under_interference": budget_min,
+        "budget_floor": floor,
+        "budget_recovered_to_default": True,  # asserted
+        "budget_chunk": chunk,
+        "gap_slo_s": cc.gap_high_s,
+        "clock_note": "virtual time (FakeClock): the dt jump is the "
+                      "deterministic interference stand-in; wall-clock "
+                      "interference lives in bench_serve_interference",
+        "n_requests": n_req,
+        "max_new_tokens": max_new,
+        "arrival_seed": seed,
+        "device": jax.devices()[0].device_kind,
+    }
+
+
+def bench_pallas_block_sweep(on_cpu: bool, seq: int = 1280,
+                             fmap: int = IMAGE_FMAP):
+    """--flagship companion: the Pallas pair-grid block-size sweep
+    (ISSUE 19). The flagship-seq axial_row mask is recompiled into a
+    BlockLayout at each (block_q, block_k) and the kernel runs at that
+    granularity; every record carries the structural ledger — visited
+    pair count and ``visited_block_frac`` (the executed-FLOP ratio) —
+    plus a per-grid-step VMEM working-set estimate against the ~16 MiB
+    per-core budget the Pallas guide documents, and the kernel wall
+    time. The sweep is the measured block-size trade: smaller blocks
+    hug the mask tighter (lower visited frac, fewer dead FLOPs) but
+    shrink the per-step MXU tile and multiply grid steps; bigger blocks
+    amortize grid overhead but pay for more masked-out work and a
+    bigger VMEM slice. Off-TPU the kernel runs in interpret mode — the
+    same trace the Mosaic lowering consumes, so CPU wall times rank
+    trace overheads only (TPU wall clock pends a device session) and
+    the structural ledger is the headline. Parity vs the shared-einsum
+    reference is asserted at EVERY block size: layout granularity must
+    never change an output bit."""
+    from dalle_pytorch_tpu.ops import block_sparse_attention as bs
+    from dalle_pytorch_tpu.ops.masks import pattern_mask
+
+    text_len = seq - fmap * fmap
+    mask = pattern_mask("axial_row", text_len, fmap)
+    rng = np.random.RandomState(0)
+    q, k, v = (
+        jnp.asarray(rng.randn(1, 2, seq, DIM_HEAD), jnp.float32)
+        for _ in range(3)
+    )
+    interpret = jax.devices()[0].platform != "tpu"
+    vmem_budget = 16 * 1024 * 1024
+    n_reps = 2 if on_cpu else 10
+
+    results = []
+    for bq, bk in ((64, 64), (128, 128), (256, 256)):
+        lay = bs.compile_block_layout(mask, bq, bk)
+        run = lambda: bs.block_sparse_attention(
+            q, k, v, lay, sm_scale=DIM_HEAD**-0.5, interpret=interpret
+        )
+        out = run()
+        ref = bs.reference_attend(q, k, v, lay, sm_scale=DIM_HEAD**-0.5)
+        err = float(jnp.max(jnp.abs(out - ref)))
+        assert err < 2e-5, (
+            f"bq{bq}/bk{bk}: block granularity changed the kernel output "
+            f"(max err {err} vs the shared-einsum reference)"
+        )
+        t0 = time.perf_counter()
+        for _ in range(n_reps):
+            jax.block_until_ready(run())
+        dt = (time.perf_counter() - t0) / n_reps
+        # per-grid-step VMEM residency, f32: q/out tiles (bq x d), k/v
+        # tiles (bk x d), the score tile (bq x bk), m/l rows
+        vmem_est = 4 * (
+            2 * bq * DIM_HEAD + 2 * bk * DIM_HEAD + bq * bk + 2 * bq
+        )
+        results.append({
+            "metric": f"pallas_block_sweep_time_bq{bq}_bk{bk}_seq{seq}",
+            "value": round(dt * 1e3, 2),
+            "unit": "ms",
+            "vs_baseline": None,
+            "pattern": "axial_row",
+            "n_pairs": lay.n_pairs,
+            "dense_pairs": lay.dense_pairs,
+            "visited_block_frac": round(lay.visited_block_frac, 4),
+            "vmem_bytes_per_step_est": vmem_est,
+            "vmem_frac_of_budget": round(vmem_est / vmem_budget, 4),
+            "kernel_reference_max_err": err,
+            "interpret_mode": interpret,
+            "wall_clock_note": (
+                "interpret-mode trace on a non-TPU host: structural "
+                "ledger is the headline, MXU wall clock pends a device "
+                "session" if interpret else None
+            ),
+            "reps": n_reps,
+            "text_len": text_len,
+            "image_fmap": fmap,
+            "device": jax.devices()[0].device_kind,
+        })
+    return results
+
+
 def model_flops_per_step(batch: int, depth: int = DEPTH) -> float:
     """Analytic fwd+bwd matmul FLOPs per train step, standard MFU convention
     (backward = 2x forward; recompute does not count)."""
@@ -2890,10 +3237,34 @@ def bench_breakdown(on_cpu: bool):
     print(format_table(groups, step_time_s=step_time, peak_flops=peak_flops()))
 
 
+def run_flagship(on_cpu: bool):
+    """--flagship: the flagship measurement session (ISSUE 19) — the full
+    serve matrix (split + int8-KV + fused + speculative + prefix
+    warm/cold + staged post-decode), the interference and recovery
+    drills, the adaptive-control record, and the Pallas block-size
+    sweep, every record provenance-stamped by _emit. Pipe stdout into a
+    BENCH_rNN.json ``tail`` and ``tools/bench_trend.py --check`` gates
+    the next session on the trend."""
+    _emit(_retry(lambda: bench_serve(on_cpu)))
+    _emit(_retry(lambda: bench_serve_quant(on_cpu)))
+    _emit(_retry(lambda: bench_serve_fused(on_cpu)))
+    _emit(_retry(lambda: bench_serve_spec(on_cpu)))
+    _emit(_retry(lambda: bench_serve_prefix(on_cpu)))
+    _emit(_retry(lambda: bench_serve_stages(on_cpu)))
+    _emit(_retry(lambda: bench_serve_interference(on_cpu)))
+    _emit(_retry(lambda: bench_serve_recovery(on_cpu)))
+    _emit(_retry(lambda: bench_serve_control(on_cpu)))
+    for r in _retry(lambda: bench_pallas_block_sweep(on_cpu)):
+        _emit(r)
+
+
 def main():
     on_cpu = jax.devices()[0].platform == "cpu"
     if "--breakdown" in sys.argv:
         _retry(lambda: bench_breakdown(on_cpu))
+        return
+    if "--flagship" in sys.argv:
+        run_flagship(on_cpu)
         return
     # selective sections for iterating (--gen / --patterns / --throughput /
     # --sweep / --ragged / --vae / --clip); no flag = the full suite,
@@ -2903,63 +3274,64 @@ def main():
     if only:
         gen_int8 = None
         if "--gen" in only:
-            print(json.dumps(_retry(lambda: bench_generation(on_cpu))))
+            _emit(_retry(lambda: bench_generation(on_cpu)))
             gen_int8 = _retry(lambda: bench_generation(on_cpu, int8=True))
-            print(json.dumps(gen_int8))
+            _emit(gen_int8)
         if "--throughput" in only:
             base = gen_int8["ms_per_token"] if gen_int8 else None
             for r in _retry(
                 lambda: bench_gen_throughput(on_cpu, base_ms_per_token=base)
             ):
-                print(json.dumps(r))
+                _emit(r)
         if "--sweep" in only:
             for r in _retry(lambda: bench_decode_sweep(on_cpu)):
-                print(json.dumps(r))
+                _emit(r)
         if "--ragged" in only:
-            print(json.dumps(_retry(lambda: bench_continuous_batching(on_cpu))))
+            _emit(_retry(lambda: bench_continuous_batching(on_cpu)))
         if "--serve" in only:
-            print(json.dumps(_retry(lambda: bench_serve(on_cpu))))
-            print(json.dumps(_retry(lambda: bench_serve_quant(on_cpu))))
-            print(json.dumps(_retry(lambda: bench_serve_fused(on_cpu))))
-            print(json.dumps(_retry(lambda: bench_serve_interference(on_cpu))))
-            print(json.dumps(_retry(lambda: bench_serve_stages(on_cpu))))
-            print(json.dumps(_retry(lambda: bench_serve_prefix(on_cpu))))
-            print(json.dumps(_retry(lambda: bench_serve_spec(on_cpu))))
-            print(json.dumps(_retry(lambda: bench_serve_recovery(on_cpu))))
+            _emit(_retry(lambda: bench_serve(on_cpu)))
+            _emit(_retry(lambda: bench_serve_quant(on_cpu)))
+            _emit(_retry(lambda: bench_serve_fused(on_cpu)))
+            _emit(_retry(lambda: bench_serve_interference(on_cpu)))
+            _emit(_retry(lambda: bench_serve_stages(on_cpu)))
+            _emit(_retry(lambda: bench_serve_prefix(on_cpu)))
+            _emit(_retry(lambda: bench_serve_spec(on_cpu)))
+            _emit(_retry(lambda: bench_serve_recovery(on_cpu)))
+            _emit(_retry(lambda: bench_serve_control(on_cpu)))
             if "--replicas" in sys.argv:
                 n = int(sys.argv[sys.argv.index("--replicas") + 1])
-                print(json.dumps(_retry(
+                _emit(_retry(
                     lambda: bench_serve_replicas(on_cpu, n_replicas=n)
-                )))
+                ))
         if "--patterns" in only:
             for r in _retry(lambda: bench_sparse_patterns(on_cpu)):
-                print(json.dumps(r))
+                _emit(r)
         if "--vae" in only:
-            print(json.dumps(_retry(lambda: bench_vae_train(on_cpu))))
+            _emit(_retry(lambda: bench_vae_train(on_cpu)))
         if "--clip" in only:
-            print(json.dumps(_retry(lambda: bench_clip_train(on_cpu))))
+            _emit(_retry(lambda: bench_clip_train(on_cpu)))
         return
     # each section prints as soon as it is measured (a later section's
     # failure must not discard already-spent device time); the headline
     # train-MFU section runs and prints last
-    print(json.dumps(_retry(lambda: bench_generation(on_cpu))))
+    _emit(_retry(lambda: bench_generation(on_cpu)))
     gen_int8 = _retry(lambda: bench_generation(on_cpu, int8=True))
-    print(json.dumps(gen_int8))
+    _emit(gen_int8)
     for r in _retry(lambda: bench_gen_throughput(
         on_cpu, base_ms_per_token=gen_int8["ms_per_token"]
     )):
-        print(json.dumps(r))
+        _emit(r)
     # paged-only sweep in the full suite (the policy-default formats are
     # already covered by the latency/throughput sections above); the full
     # 3-format matrix runs under --sweep
     for r in _retry(lambda: bench_decode_sweep(on_cpu, formats=("paged",))):
-        print(json.dumps(r))
-    print(json.dumps(_retry(lambda: bench_continuous_batching(on_cpu))))
+        _emit(r)
+    _emit(_retry(lambda: bench_continuous_batching(on_cpu)))
     for r in _retry(lambda: bench_sparse_patterns(on_cpu)):
-        print(json.dumps(r))
-    print(json.dumps(_retry(lambda: bench_vae_train(on_cpu))))
-    print(json.dumps(_retry(lambda: bench_clip_train(on_cpu))))
-    print(json.dumps(_retry(lambda: bench_train(on_cpu))))
+        _emit(r)
+    _emit(_retry(lambda: bench_vae_train(on_cpu)))
+    _emit(_retry(lambda: bench_clip_train(on_cpu)))
+    _emit(_retry(lambda: bench_train(on_cpu)))
 
 
 if __name__ == "__main__":

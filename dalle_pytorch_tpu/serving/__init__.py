@@ -3,6 +3,7 @@ control, page-pool pressure handling, and the replicated front door.
 See engine.py for the single-replica architecture, router.py for the
 fleet coordinator, and docs/DESIGN.md for the failure models."""
 
+from .control import ControlConfig, Controller, Decision
 from .engine import Engine, EngineConfig, check_accounting
 from .journal import (
     JournalCorrupt,
@@ -26,6 +27,9 @@ from .types import (
 
 __all__ = [
     "Clock",
+    "ControlConfig",
+    "Controller",
+    "Decision",
     "Engine",
     "EngineConfig",
     "EngineUnsupportedModel",

@@ -1,0 +1,239 @@
+"""Deterministic adaptive control loop over the engine's vitals.
+
+The engine exposes knobs that are safe to move at runtime — but only
+through channels that keep the trace contracts (DTL11x) holding by
+construction, because every one of them is DATA to the serving jits,
+never a static argument:
+
+==================  ====================================================
+knob                channel
+==================  ====================================================
+``spec_k``          the per-row VERIFY width is data (the ``length``
+                    descriptor); the jit's static ``spec_k`` stays the
+                    config ceiling it was traced with, so stepping the
+                    effective width within [1, ceiling] can never
+                    recompile — and exact-match acceptance keeps tokens
+                    bit-identical at ANY width (engine._spec_iteration)
+``token budget``    scheduler.TokenBudget is a frozen host-side policy
+                    value; replacing it with a tighter/looser budget at
+                    the SAME chunk width changes prefill grants, not
+                    chunk shapes (the chunk width is what the trace
+                    sees); the scheduler's head-of-line floor keeps
+                    liveness at any budget
+``watermark``       the degradation threshold engine._clamped_budget
+                    compares occupancy against — pure host arithmetic
+``prefix share``    a pages target applied through the index's own LRU
+                    eviction tier (engine._reclaim_index_pages), which
+                    only ever drops unreferenced cached pages
+==================  ====================================================
+
+The controller itself is a pure, deterministic function of its inputs:
+same vitals window sequence -> same decision sequence (no wall clock, no
+randomness), which is what makes the ``serve.control.decision`` event
+journal a bit-deterministic replay log (docs/DESIGN.md §8.6). The
+``control_stall`` fault site models a stuck/buggy controller: evaluation
+raises, the ENGINE degrades every effective knob to its static default,
+and the stall is typed and counted — decode progress never depends on
+the control loop being alive.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.faults import FAULTS
+
+
+@dataclass(frozen=True)
+class ControlConfig:
+    """Thresholds for the decision ladder. All comparisons are strict
+    and hysteresis is explicit (a down-threshold and an up-threshold per
+    knob), so the loop cannot oscillate on a flat signal."""
+
+    # controller cadence, in worked engine iterations
+    interval: int = 8
+    # --- spec_k ladder: windowed accept rate vs the draft ceiling ---
+    spec_accept_low: float = 0.45   # below: step the verify width down
+    spec_accept_high: float = 0.85  # at/above: step back up toward ceiling
+    # minimum drafted tokens in the window before adapting (noise gate)
+    spec_min_drafts: int = 4
+    # --- token-budget ladder: windowed max decode-iteration gap ---
+    gap_high_s: float = 0.25        # above: tighten the budget one chunk
+    gap_low_frac: float = 0.5       # below gap_high*frac: relax one chunk
+    budget_min_frac: float = 0.5    # floor as a fraction of the default
+    # --- watermark ladder: windowed deadline-miss rate ---
+    miss_rate_high: float = 0.25    # above: clamp the effective watermark
+    miss_rate_low_frac: float = 0.5 # below high*frac: restore the default
+    watermark_clamp: float = 0.5    # the clamped effective watermark
+    # --- prefix-arena ladder: windowed mean occupancy ---
+    occupancy_shed: float = 0.9     # above: shed cached pages to the min
+    occupancy_restore_frac: float = 0.5  # below shed*frac: stop shedding
+    prefix_pages_min: int = 0       # pages target while shedding
+    # decision log retention (oldest dropped past this)
+    max_log: int = 4096
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One controller evaluation: the vitals it saw, the knobs it
+    chose, and why — the audit record every ``serve.control.decision``
+    event carries."""
+
+    iteration: int
+    vitals: Dict[str, float]
+    knobs: Dict[str, Optional[float]]
+    changed: bool
+    stalled: bool = False
+    reasons: Tuple[str, ...] = ()
+
+
+class ControlStall(RuntimeError):
+    """The controller evaluation failed (the ``control_stall`` fault, or
+    a real bug in a ladder) — the engine catches this and degrades to
+    static defaults."""
+
+
+class Controller:
+    """Deterministic vitals -> knobs mapper with explicit state.
+
+    The constructor pins the static defaults (the knob values the engine
+    was built with); ``evaluate`` walks the decision ladder and returns
+    a ``Decision``; ``reset`` restores every knob to its default (the
+    stall degrade). The engine owns APPLYING knobs — this class never
+    touches the engine, so it is trivially testable and replayable.
+    """
+
+    def __init__(self, config: ControlConfig, *,
+                 spec_k_ceiling: Optional[int] = None,
+                 budget_default: Optional[int] = None,
+                 chunk: int = 1,
+                 watermark_default: float = 0.85,
+                 prefix_enabled: bool = False):
+        assert config.interval >= 1, config.interval
+        self.config = config
+        self.spec_k_ceiling = spec_k_ceiling
+        self.budget_default = budget_default
+        self.chunk = max(1, int(chunk))
+        self.watermark_default = float(watermark_default)
+        self.prefix_enabled = prefix_enabled
+        self.log: List[Decision] = []
+        self._knobs = self.defaults()
+
+    def defaults(self) -> Dict[str, Optional[float]]:
+        """The static-config knob values — the controller-off state and
+        the stall-degrade target."""
+        return {
+            "spec_k": (
+                float(self.spec_k_ceiling)
+                if self.spec_k_ceiling is not None else None
+            ),
+            "budget": (
+                float(self.budget_default)
+                if self.budget_default is not None else None
+            ),
+            "watermark": self.watermark_default,
+            # None = no target (the arena keeps its configured capacity)
+            "prefix_pages_target": None,
+        }
+
+    @property
+    def knobs(self) -> Dict[str, Optional[float]]:
+        return dict(self._knobs)
+
+    def reset(self) -> None:
+        self._knobs = self.defaults()
+
+    def record_stall(self, iteration: int,
+                     vitals: Dict[str, float]) -> Decision:
+        """Log the degrade-to-defaults decision after a stall (the
+        engine calls this AFTER ``reset``)."""
+        d = Decision(
+            iteration=iteration, vitals=dict(vitals), knobs=self.knobs,
+            changed=True, stalled=True, reasons=("control_stall",),
+        )
+        self._append(d)
+        return d
+
+    def evaluate(self, iteration: int,
+                 vitals: Dict[str, float]) -> Decision:
+        """Walk the decision ladder over one vitals snapshot. Raises
+        ``ControlStall`` when the fault site is armed (the injectable
+        stuck-controller drill)."""
+        if FAULTS.take("control_stall"):
+            raise ControlStall("control_stall fault armed")
+        cfg = self.config
+        k = dict(self._knobs)
+        reasons: List[str] = []
+
+        # 1) speculative verify width: track the windowed accept rate
+        if k["spec_k"] is not None and (
+            vitals.get("spec_drafted", 0.0) >= cfg.spec_min_drafts
+        ):
+            rate = vitals.get("spec_accept_rate", 0.0)
+            cur = int(k["spec_k"])
+            if rate < cfg.spec_accept_low and cur > 1:
+                k["spec_k"] = float(cur - 1)
+                reasons.append("spec_down")
+            elif rate >= cfg.spec_accept_high and cur < self.spec_k_ceiling:
+                k["spec_k"] = float(cur + 1)
+                reasons.append("spec_up")
+
+        # 2) token budget: bound prefill interference by the windowed
+        # max decode-iteration gap
+        if k["budget"] is not None:
+            gap = vitals.get("decode_gap_s", 0.0)
+            cur_b = int(k["budget"])
+            floor = max(
+                self.chunk,
+                int(self.budget_default * cfg.budget_min_frac),
+            )
+            if gap > cfg.gap_high_s and cur_b > floor:
+                k["budget"] = float(max(floor, cur_b - self.chunk))
+                reasons.append("budget_down")
+            elif (
+                gap <= cfg.gap_high_s * cfg.gap_low_frac
+                and cur_b < self.budget_default
+            ):
+                k["budget"] = float(
+                    min(self.budget_default, cur_b + self.chunk)
+                )
+                reasons.append("budget_up")
+
+        # 3) watermark: clamp admissions earlier while deadlines burn
+        miss = vitals.get("deadline_miss_rate", 0.0)
+        if miss > cfg.miss_rate_high:
+            if k["watermark"] > cfg.watermark_clamp:
+                k["watermark"] = cfg.watermark_clamp
+                reasons.append("watermark_clamp")
+        elif miss <= cfg.miss_rate_high * cfg.miss_rate_low_frac:
+            if k["watermark"] != self.watermark_default:
+                k["watermark"] = self.watermark_default
+                reasons.append("watermark_restore")
+
+        # 4) prefix-arena share: shed cached pages under sustained
+        # occupancy pressure, stop shedding once it relaxes
+        if self.prefix_enabled:
+            occ = vitals.get("occupancy", 0.0)
+            if occ > cfg.occupancy_shed:
+                if k["prefix_pages_target"] != float(cfg.prefix_pages_min):
+                    k["prefix_pages_target"] = float(cfg.prefix_pages_min)
+                    reasons.append("prefix_shed")
+            elif occ <= cfg.occupancy_shed * cfg.occupancy_restore_frac:
+                if k["prefix_pages_target"] is not None:
+                    k["prefix_pages_target"] = None
+                    reasons.append("prefix_restore")
+
+        changed = k != self._knobs
+        self._knobs = k
+        d = Decision(
+            iteration=iteration, vitals=dict(vitals), knobs=dict(k),
+            changed=changed, reasons=tuple(reasons),
+        )
+        self._append(d)
+        return d
+
+    def _append(self, d: Decision) -> None:
+        self.log.append(d)
+        if len(self.log) > self.config.max_log:
+            del self.log[: len(self.log) - self.config.max_log]

@@ -126,6 +126,8 @@ from ..utils.resilience import (
     retry_after_hint, verify_dir_manifest, write_dir_manifest,
 )
 from ..utils.telemetry import TELEMETRY
+from ..utils import vitals as vitals_mod
+from .control import ControlConfig, Controller
 from .postdecode import PostDecodePipeline, StageSpec
 from .prefix_cache import (
     PrefixCache,
@@ -242,6 +244,22 @@ class EngineConfig:
     # None defers to DALLE_TPU_KV_QUANT / the "none" default; an
     # invalid value fails typed at Engine construction.
     kv_quant: Optional[str] = None
+    # ---- observability & adaptive control (docs/DESIGN.md §8.6) ----
+    # engine vitals: sliding-window reductions over existing metrics,
+    # published as serve.vitals.* gauges each iteration (utils/vitals.py)
+    vitals: bool = False
+    # window length, in worked iterations
+    vitals_window: int = 32
+    # charge each serving jit's cost_analysis() FLOPs/bytes into the
+    # vitals cost ledger ONCE per signature (an extra lowering per jit
+    # name, off the timed path) so roofline fraction is a live gauge
+    cost_ledger: bool = False
+    # deterministic adaptive control loop (serving/control.py): maps
+    # vitals windows to effective knobs between iterations, through
+    # data-only channels that cannot recompile. Implies vitals.
+    controller: bool = False
+    # controller thresholds; None = ControlConfig() defaults
+    control: Optional[ControlConfig] = None
 
 
 _PREFILL = "prefill"
@@ -1036,6 +1054,49 @@ class Engine:
                     else self.pool.occupancy
                 ),
             )
+        # observability & adaptive control (docs/DESIGN.md §8.6). The
+        # EFFECTIVE knobs start at the config values and only ever move
+        # through the controller's data-only channels: the spec verify
+        # width stays within the pre-traced ceiling (config.spec_k, the
+        # static argument), the watermark is host arithmetic, and the
+        # TokenBudget swaps at a FIXED chunk width — controller off, all
+        # three equal the config and the engine is bit-identical to one
+        # built without this block.
+        self._eff_spec_k = config.spec_k
+        self._eff_watermark = config.high_watermark
+        self._last_jit_name: Optional[str] = None
+        self.vitals: Optional[vitals_mod.Vitals] = None
+        self.controller: Optional[Controller] = None
+        self._control_interval = 0
+        if config.vitals or config.controller:
+            peaks = None
+            if config.cost_ledger:
+                try:
+                    peaks = vitals_mod.peaks_for(
+                        jax.devices()[0].device_kind
+                    )
+                except Exception:
+                    peaks = None
+            self.vitals = vitals_mod.Vitals(
+                window=config.vitals_window, peaks=peaks
+            )
+        if config.controller:
+            cc = config.control if config.control is not None else (
+                ControlConfig()
+            )
+            self._control_interval = cc.interval
+            self.controller = Controller(
+                cc,
+                spec_k_ceiling=config.spec_k if self.spec else None,
+                budget_default=(
+                    self.budget.budget if self.budget is not None else None
+                ),
+                chunk=(
+                    self.budget.chunk if self.budget is not None else 1
+                ),
+                watermark_default=config.high_watermark,
+                prefix_enabled=self.prefix is not None,
+            )
         self._publish_kv_gauges()
 
     def _kv_format_tag(self) -> bytes:
@@ -1164,6 +1225,13 @@ class Engine:
         if worked:
             self.iterations += 1
         self.clock.tick()
+        if self.vitals is not None and worked:
+            self._observe_vitals()
+            if (
+                self.controller is not None
+                and self.iterations % self._control_interval == 0
+            ):
+                self._run_controller()
         self._publish_gauges()
         return (worked or bool(self.sched) or any(self.slots)
                 or bool(self.postdecode))
@@ -2093,7 +2161,7 @@ class Engine:
         )
         if (
             cfg.degraded_max_new_tokens is not None
-            and occ > cfg.high_watermark
+            and occ > self._eff_watermark
             and want > cfg.degraded_max_new_tokens
         ):
             return cfg.degraded_max_new_tokens, True
@@ -2504,12 +2572,14 @@ class Engine:
             keys = keys.at[jnp.asarray(key_idx)].set(jnp.stack(key_list))
         self.dispatches += 1
         self.counters.inc("serve.dispatches")
-        self.cache, samples, flogits = _iteration_jit(
+        jit_args = (
             self.dalle, self.params, self.cache, self._prompts,
             tok, jnp.asarray(start), jnp.asarray(length), jnp.asarray(final),
             keys, self._W, self.k_img, self.config.temperature,
             bool(final.any()),
         )
+        self._maybe_charge_cost("iteration", _iteration_jit, jit_args)
+        self.cache, samples, flogits = _iteration_jit(*jit_args)
         for s in self.slots:
             if s is not None and s.phase == _DECODE:
                 s.tok_on_device = False
@@ -2603,9 +2673,12 @@ class Engine:
             remaining = s.entry.effective_max_new - len(s.entry.generated)
             # capping the verify width at the remaining budget keeps the
             # worst-case page demand identical to plain decode (the last
-            # written position never passes T + max_new - 2)
+            # written position never passes T + max_new - 2). The
+            # EFFECTIVE spec_k (controller-adjustable, <= the static
+            # cfg.spec_k the jit was traced with) is pure row data — the
+            # adaptation channel that cannot recompile (DESIGN §8.6)
             widths[id(s)] = 1 if not spec_on else min(
-                cfg.spec_k + 1, remaining
+                self._eff_spec_k + 1, remaining
             )
         for slot in sorted(
             dispatchable,
@@ -2698,12 +2771,18 @@ class Engine:
             )
         self.dispatches += 1
         self.counters.inc("serve.dispatches")
-        self.cache, samples, accepted, flogits = _spec_iteration_jit(
+        jit_args = (
             self.dalle, self.params, self.cache, self._prompts,
             tok, jnp.asarray(start), jnp.asarray(length), jnp.asarray(final),
             self._base_keys, W, self.k_img, self.config.temperature,
             bool(final.any()), self.config.spec_k,
             self.config.spec_draft_depth,
+        )
+        self._maybe_charge_cost(
+            "iteration_spec", _spec_iteration_jit, jit_args
+        )
+        self.cache, samples, accepted, flogits = _spec_iteration_jit(
+            *jit_args
         )
         self._advance_dispatched_chunks(chunks, final, flogits)
         return samples, accepted, entries
@@ -2868,11 +2947,13 @@ class Engine:
         )
         self.dispatches += 1
         self.counters.inc("serve.dispatches")
-        self.cache, samples = _decode_jit(
+        jit_args = (
             self.dalle, self.params, self.cache,
             tok, jnp.asarray(pos), keys,
             self.k_img, self.config.temperature,
         )
+        self._maybe_charge_cost("decode", _decode_jit, jit_args)
+        self.cache, samples = _decode_jit(*jit_args)
         for s in self.slots:
             if s is not None and s.phase == _DECODE:
                 s.tok_on_device = False
@@ -3203,8 +3284,128 @@ class Engine:
             f"{index_pages} owned by the prefix index"
         )
 
+    # ------------------------- vitals & adaptive control (DESIGN §8.6)
+
+    def _observe_vitals(self) -> None:
+        """Push one iteration's plain-number sample set into the vitals
+        windows — cumulative counters in, windowed reductions out
+        (utils/vitals.py). Strictly host arithmetic."""
+        occ = (
+            self._fleet_occupancy()
+            if self._fleet_occupancy is not None
+            else self.pool.occupancy
+        )
+        self.vitals.observe_iteration(
+            now=self.clock.now(),
+            occupancy=occ,
+            stage_queued=(
+                0.0 if self.postdecode is None else len(self.postdecode)
+            ),
+            spec_drafted=self._spec_drafted,
+            spec_accepted=self._spec_accepted,
+            prefix_hits=self._prefix_hits,
+            prefix_misses=self._prefix_misses,
+            deadline_misses=self._outcome_counts[Outcome.DEADLINE_EXCEEDED],
+            terminations=sum(self._outcome_counts.values()),
+            jit_name=self._last_jit_name,
+        )
+
+    def _maybe_charge_cost(self, name: str, fn, args: tuple) -> None:
+        """Charge the vitals cost ledger ONCE per jit name with the
+        executable's own cost_analysis() FLOPs/bytes. Uses AOT lowering
+        (``fn.lower`` never executes, so donated buffers are safe) and
+        fails open: the ledger is observability, never load-bearing."""
+        self._last_jit_name = name
+        if (
+            self.vitals is None
+            or not self.config.cost_ledger
+            or self.vitals.ledger.has(name)
+        ):
+            return
+        try:
+            ca = fn.lower(*args).cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            self.vitals.ledger.charge(
+                name,
+                float(ca.get("flops", 0.0) or 0.0),
+                float(ca.get("bytes accessed", 0.0) or 0.0),
+            )
+        except Exception:
+            self.vitals.ledger.charge(name, 0.0, 0.0)
+
+    def _run_controller(self) -> None:
+        """One controller evaluation between iterations: vitals window
+        in, effective knobs out, the whole decision journaled as a
+        ``serve.control.decision`` event. A raising controller (the
+        ``control_stall`` fault, or a real bug) degrades every knob to
+        its static default — typed, counted, and never fatal to decode
+        progress."""
+        snap = self.vitals.snapshot()
+        self.counters.inc("serve.control.decisions")
+        try:
+            decision = self.controller.evaluate(self.iterations, snap)
+        except Exception:
+            self.counters.inc("serve.fault_control_stall")
+            self.counters.inc("serve.control.stalls")
+            self.controller.reset()
+            decision = self.controller.record_stall(self.iterations, snap)
+        if decision.changed:
+            self.counters.inc("serve.control.adjustments")
+        self._apply_knobs(decision)
+        TELEMETRY.event(
+            "serve.control.decision",
+            iteration=decision.iteration,
+            changed=decision.changed,
+            stalled=decision.stalled,
+            reasons=list(decision.reasons),
+            vitals=dict(decision.vitals),
+            knobs=dict(decision.knobs),
+        )
+
+    def _apply_knobs(self, decision) -> None:
+        """Apply a Decision's knobs through the data-only channels (see
+        serving/control.py's knob/channel table) and publish the
+        effective levels as ``serve.control.*`` gauges."""
+        k = decision.knobs
+        if self.spec and k.get("spec_k") is not None:
+            # clamp to the pre-traced ceiling: the static argument the
+            # spec jit was traced with is config.spec_k, and the
+            # effective width only narrows rows within it
+            self._eff_spec_k = min(
+                max(1, int(k["spec_k"])), self.config.spec_k
+            )
+        self._eff_watermark = float(k["watermark"])
+        if self.budget is not None and k.get("budget") is not None:
+            b = max(1, int(k["budget"]))
+            if b != self.budget.budget:
+                # same chunk width: grant SIZES are what the traces see;
+                # only the per-iteration grant COUNT moves
+                self.budget = TokenBudget(budget=b, chunk=self.budget.chunk)
+        tgt = k.get("prefix_pages_target")
+        if tgt is not None and self.prefix is not None:
+            excess = len(self.prefix) - max(0, int(tgt))
+            if excess > 0:
+                self._reclaim_index_pages(
+                    min(excess, self.prefix.reclaimable_pages())
+                )
+        self.gauges.set("serve.control.spec_k", float(self._eff_spec_k))
+        self.gauges.set(
+            "serve.control.budget",
+            float(self.budget.budget)
+            if self.budget is not None and self.budget.budget is not None
+            else -1.0,
+        )
+        self.gauges.set("serve.control.watermark", self._eff_watermark)
+        self.gauges.set(
+            "serve.control.prefix_pages_target",
+            -1.0 if tgt is None else float(tgt),
+        )
+
     def _publish_gauges(self) -> None:
         self._publish_kv_gauges()
+        if self.vitals is not None:
+            self.vitals.publish(self.gauges)
         self.gauges.set("serve.pool_occupancy", self.pool.occupancy)
         self.gauges.set(
             "serve.running",

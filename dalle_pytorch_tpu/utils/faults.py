@@ -94,6 +94,12 @@ Sites in use:
                  per-dispatch time budget — same retry-then-degrade
                  path as a stage failure, counted separately
                  (``serve.stage.timeouts``)
+``control_stall`` ``serving.control``: one controller evaluation raises
+                 (a stuck/buggy control loop) — the engine degrades that
+                 evaluation to the STATIC config defaults (every
+                 effective knob reset), typed and counted
+                 (``serve.control.stalls``); decode progress never
+                 depends on the controller being alive
 ===============  =============================================================
 
 Injection must be impossible to leave on by accident: the registry is
@@ -125,6 +131,7 @@ KNOWN_SITES = frozenset({
     "spec_verify_abort",
     "replica_respawn_fail", "journal_torn", "snapshot_corrupt",
     "vae_decode_fail", "rerank_fail", "stage_timeout",
+    "control_stall",
 })
 
 

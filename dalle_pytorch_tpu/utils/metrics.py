@@ -303,6 +303,130 @@ class Histogram:
                 },
             }
 
+    def checkpoint(self) -> "HistogramCheckpoint":
+        """Freeze the cumulative state for later ``snapshot_delta``.
+
+        The Prometheus series stays monotone — windowing is the READER's
+        subtraction, never a reset of the producer's counters (resetting
+        would corrupt every other consumer's rate() over the same
+        series). One lock hold, so the checkpoint is internally
+        consistent with itself."""
+        with self._lock:
+            return HistogramCheckpoint(
+                counts=tuple(self._counts), count=self.count, sum=self.sum,
+                max=self.max,
+            )
+
+    def snapshot_delta(
+        self, prev: Optional["HistogramCheckpoint"] = None
+    ) -> Dict[str, float]:
+        """Windowed stats since ``prev`` (a ``checkpoint()``): count, sum,
+        mean, p50/p95/p99 computed over the bucket-count DIFFERENCES, so
+        sliding-window percentiles never require resetting the cumulative
+        series. ``prev=None`` — or a checkpoint from a different bucket
+        geometry, or one newer than the current state (the registry was
+        reset) — degrades to the full lifetime window.
+
+        Window percentiles inherit the bucket resolution: each is the
+        upper bound of its delta bucket (overflow hits report the
+        lifetime max, the only max the buckets retain)."""
+        with self._lock:
+            dc = list(self._counts)
+            count, total = self.count, self.sum
+            if prev is not None and len(prev.counts) == len(dc):
+                cand = [c - p for c, p in zip(dc, prev.counts)]
+                if min(cand, default=0) >= 0 and self.count >= prev.count:
+                    dc = cand
+                    count = self.count - prev.count
+                    total = self.sum - prev.sum
+            out = {"count": float(count), "sum": total,
+                   "mean": total / count if count else 0.0}
+            for q in (50, 95, 99):
+                out[f"p{q}"] = self._rank_walk_locked(dc, count, q)
+            return out
+
+    def _rank_walk_locked(self, dc: List[int], count: int, q: float) -> float:
+        if count <= 0:
+            return 0.0
+        rank = max(1, math.ceil(q / 100.0 * count))
+        seen = 0
+        for i, c in enumerate(dc):
+            seen += c
+            if seen >= rank:
+                if i >= len(self.bounds):  # overflow
+                    return self.max
+                return min(self.bounds[i], self.max)
+        return self.max  # unreachable; dc sums to count
+
+
+class HistogramCheckpoint:
+    """Immutable cumulative-state marker for ``Histogram.snapshot_delta``
+    — counts tuple + count/sum/max frozen under one lock hold."""
+
+    __slots__ = ("counts", "count", "sum", "max")
+
+    def __init__(self, counts: Tuple[int, ...], count: int, sum: float,
+                 max: float):
+        self.counts = counts
+        self.count = count
+        self.sum = sum
+        self.max = max
+
+
+class GaugeRing:
+    """Fixed-capacity ring of gauge samples — the sliding-window
+    companion to ``Gauges`` for level metrics (occupancy, queue depth,
+    iteration gap) whose last value alone cannot answer "over the recent
+    window". Push is O(1) and allocation-free after warmup; ``window()``
+    reduces the live samples in one lock hold. Old samples fall off by
+    capacity, so the window length is measured in pushes (the vitals
+    layer pushes once per engine iteration)."""
+
+    _GUARDED_BY = {"_lock": ("_buf", "_next", "_filled")}
+
+    def __init__(self, capacity: int = 64):
+        assert capacity >= 1, capacity
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._buf: List[float] = [0.0] * capacity
+        self._next = 0
+        self._filled = 0
+
+    def push(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._buf[self._next] = v
+            self._next = (self._next + 1) % self.capacity
+            if self._filled < self.capacity:
+                self._filled += 1
+
+    def values(self) -> List[float]:
+        """Live samples, oldest first."""
+        with self._lock:
+            if self._filled < self.capacity:
+                return self._buf[: self._filled]
+            return self._buf[self._next:] + self._buf[: self._next]
+
+    def window(self) -> Dict[str, float]:
+        """count/last/mean/min/max over the live samples (one lock
+        hold); all-zero when nothing has been pushed yet."""
+        with self._lock:
+            n = self._filled
+            if n == 0:
+                return {"count": 0.0, "last": 0.0, "mean": 0.0,
+                        "min": 0.0, "max": 0.0}
+            if n < self.capacity:
+                live = self._buf[:n]
+            else:
+                live = self._buf
+            return {
+                "count": float(n),
+                "last": self._buf[(self._next - 1) % self.capacity],
+                "mean": sum(live) / n,
+                "min": min(live),
+                "max": max(live),
+            }
+
 
 class Histograms:
     """Process-wide named histograms, created on first observe — same

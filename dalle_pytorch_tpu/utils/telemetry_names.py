@@ -65,6 +65,10 @@ EVENTS = frozenset({
     "serve.prefill_retry",
     "serve.prefix_hit",      # admission mapped >=1 cached prompt page
     "serve.snapshot_reject", # prefix snapshot failed verify-on-load
+    # adaptive control loop (serving/control.py): one per controller
+    # evaluation, carrying its input vitals and output knobs — the
+    # audit/replay record (DESIGN.md §8.6)
+    "serve.control.decision",
     # replicated front door
     "router.respawn",        # dead replica rebuilt and readmitted HEALTHY
     "router.respawn_fail",   # a respawn attempt failed (or exhausted)
@@ -124,6 +128,11 @@ COUNTERS = frozenset({
     "serve.fault_vae_decode_fail",
     "serve.fault_rerank_fail",
     "serve.fault_stage_timeout",
+    "serve.fault_control_stall",
+    # adaptive control loop (serving/control.py; DESIGN.md §8.6)
+    "serve.control.decisions",    # controller evaluations run
+    "serve.control.adjustments",  # evaluations that changed >=1 knob
+    "serve.control.stalls",       # evaluations degraded to static defaults
     # post-decode pipeline (serving/postdecode.py; DESIGN.md §8.5)
     "serve.stage.enqueued",        # requests entering the pipeline
     "serve.stage.vae_images",      # VAE_DECODE stage completions (images)
@@ -211,6 +220,20 @@ GAUGES = frozenset({
     # headline bench.py --serve asserts
     "serve.kv_quant.bytes_per_slot",
     "serve.kv_quant.pages",
+    # engine vitals: sliding-window reductions over existing metrics
+    # (utils/vitals.py; DESIGN.md §8.6) — the controller's inputs
+    "serve.vitals.spec_accept_rate",    # windowed accepted/drafted
+    "serve.vitals.prefix_hit_frac",     # windowed hits/(hits+misses)
+    "serve.vitals.decode_gap_s",        # windowed max inter-iteration gap
+    "serve.vitals.stage_lag",           # windowed mean post-decode depth
+    "serve.vitals.deadline_miss_rate",  # windowed misses/terminations
+    "serve.vitals.occupancy",           # windowed mean pool occupancy
+    "serve.vitals.roofline_frac",       # iteration FLOPs/s vs device peak
+    # effective knob levels the control loop last applied
+    "serve.control.spec_k",
+    "serve.control.budget",
+    "serve.control.watermark",
+    "serve.control.prefix_pages_target",
     "router.queued",
     "router.fleet_occupancy",
     "router.replicas_live",

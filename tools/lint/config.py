@@ -102,6 +102,7 @@ _HOST_ONLY_FILES = (
     "dalle_pytorch_tpu/utils/metrics.py",
     "dalle_pytorch_tpu/utils/faults.py",
     "dalle_pytorch_tpu/utils/resilience.py",
+    "dalle_pytorch_tpu/utils/vitals.py",
 )
 
 _JAX_STACK = ("jax", "jaxlib", "flax", "optax")

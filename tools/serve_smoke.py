@@ -75,29 +75,34 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def lint_preflight(label: str = "serve smoke") -> int:
-    """Static-analysis pre-flight (docs/DESIGN.md §11), all three lint
+    """Static-analysis + trend pre-flight (docs/DESIGN.md §11), all four
     stages in escalation order: first the AST stage alone (``lint.py
     --check`` — stdlib-only, so a corrupt tree still fails in
-    milliseconds), then the TRACE + SHARD composition (``lint.py
-    --trace --shard --check``, one subprocess — the CLI composes both
-    contract stages in one exit code, so the preflight pays one
-    jax+package import, not two): every serving jit this gate is about
-    to drive must match its committed compile-signature/donation/
-    readback/HBM contract (tools/trace_contracts.json) AND hold the
-    committed "no collectives in serving" baseline, with the train step
-    holding its per-mesh-kind collective/sharding contract
-    (tools/shard_contracts.json), BEFORE a request is admitted.
-    Subprocesses on purpose: the AST stage must not inherit this
-    process's jax initialization, and the contract stages re-import the
-    package fresh so a broken import fails the gate, not the drill."""
+    milliseconds), then the bench TREND gate (``bench_trend.py --check``
+    — also stdlib-only: the committed BENCH_r*.json history must hold
+    its per-metric tolerances, so a perf regression fails red before a
+    correctness smoke even runs; ISSUE 19), then the TRACE + SHARD
+    composition (``lint.py --trace --shard --check``, one subprocess —
+    the CLI composes both contract stages in one exit code, so the
+    preflight pays one jax+package import, not two): every serving jit
+    this gate is about to drive must match its committed
+    compile-signature/donation/readback/HBM contract
+    (tools/trace_contracts.json) AND hold the committed "no collectives
+    in serving" baseline, with the train step holding its per-mesh-kind
+    collective/sharding contract (tools/shard_contracts.json), BEFORE a
+    request is admitted. Subprocesses on purpose: the AST stage must
+    not inherit this process's jax initialization, and the contract
+    stages re-import the package fresh so a broken import fails the
+    gate, not the drill."""
     import subprocess
 
-    for stage, args in (
-        ("lint", ["--check"]),
-        ("contract-lint", ["--trace", "--shard", "--check"]),
+    for stage, script, args in (
+        ("lint", "lint.py", ["--check"]),
+        ("bench-trend", "bench_trend.py", ["--check"]),
+        ("contract-lint", "lint.py", ["--trace", "--shard", "--check"]),
     ):
         proc = subprocess.run(
-            [sys.executable, str(REPO / "tools" / "lint.py"), *args],
+            [sys.executable, str(REPO / "tools" / script), *args],
             capture_output=True, text=True, cwd=REPO,
         )
         if proc.returncode != 0:

@@ -30,7 +30,13 @@ release needs (docs/DESIGN.md §9):
    the per-replica labeled series (``serve_submitted{replica="0"}``)
    render in the exposition, and ``Engine.verify_invariants`` /
    ``Router.verify_invariants`` — the same public invariant surface the
-   router's health machine probes — hold after the run.
+   router's health machine probes — hold after the run;
+6. a controller-on pass (serving/control.py, ISSUE 19) runs traced on
+   virtual time: every Controller evaluation lands as one
+   ``serve.control.decision`` instant event in the flight file (one per
+   decision-log entry — the auditable decision record), the spans the
+   pass adds still balance, and the ``serve_vitals_*`` gauges plus the
+   ``serve_control_*`` series render in ``/metrics``.
 
 Exit 0 iff all hold::
 
@@ -287,6 +293,53 @@ def main(argv=None) -> int:
         check(series in dump,
               f"per-replica/router series {series!r} missing from /metrics")
 
+    # -- 6. adaptive control loop, traced (ISSUE 19) ----------------------
+    from dalle_pytorch_tpu.serving import ControlConfig, Engine, FakeClock
+
+    eng = Engine(dalle, params, EngineConfig(
+        max_batch=2, prefill_chunk=2, fused_iteration=True,
+        controller=True, cost_ledger=True,
+        control=ControlConfig(interval=2),
+    ), clock=FakeClock(step_dt=1.0))
+    rng = np.random.RandomState(5)
+    for i in range(3):
+        eng.submit(Request(
+            request_id=f"ctrl{i}",
+            prompt=rng.randint(1, 16, size=(4,)).astype(np.int32),
+            max_new_tokens=dalle.image_seq_len, seed=300 + i,
+        ))
+    eng.run(max_steps=800)
+    eng.verify_invariants(idle=True)
+    check(
+        all(res.outcome is Outcome.COMPLETED
+            for res in eng.results.values()),
+        f"controller pass outcomes: "
+        f"{[r.outcome.value for r in eng.results.values()]}",
+    )
+    check(len(eng.controller.log) >= 1,
+          "controller pass finished without a single evaluation")
+    cpath = TELEMETRY.drain("control")
+    check(cpath is not None, "control drain produced no flight file")
+    decision_events = 0
+    if cpath is not None:
+        csummary = validate_flight_file(cpath)
+        cunbalanced = _non_postmortem_unclosed(cpath, csummary)
+        check(cunbalanced == [],
+              f"controller-pass spans left open: {cunbalanced}")
+        decision_events = csummary["by_name"].get(
+            "serve.control.decision", 0
+        )
+        check(decision_events == len(eng.controller.log),
+              f"{len(eng.controller.log)} controller decisions but "
+              f"{decision_events} serve.control.decision events in the "
+              f"flight file — the audit trail is incomplete")
+    dump = TELEMETRY.dump()
+    for series in ("serve_vitals_occupancy", "serve_vitals_decode_gap_s",
+                   "serve_vitals_roofline_frac", "serve_control_decisions",
+                   "serve_control_budget"):
+        check(series in dump,
+              f"vitals/control series {series!r} missing from /metrics")
+
     print(json.dumps({
         "flight_file": path,
         "records": summary["records"],
@@ -299,12 +352,14 @@ def main(argv=None) -> int:
         "interference_monolithic_max_gap_ms":
             interference["monolithic_max_gap_ms"],
         "router_request_spans": router_spans,
+        "control_decision_events": decision_events,
     }))
     if not ok:
         return 1
     print(f"telemetry smoke OK: {n_req} request span chains balanced, "
           f"{summary['records']} records, /metrics renders, interference "
-          f"scenario traced, router pass traced with per-replica series",
+          f"scenario traced, router pass traced with per-replica series, "
+          f"controller pass traced with {decision_events} decision events",
           file=sys.stderr)
     return 0
 
